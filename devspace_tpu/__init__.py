@@ -1,0 +1,15 @@
+"""tpu-devspace: a TPU-native developer-loop framework.
+
+A single CLI that takes a project from zero to a live, hot-reloading
+development session on Google Cloud TPU slices: ``init`` scaffolds JAX/XLA
+Dockerfiles and charts requesting ``google.com/tpu``, ``deploy`` builds and
+ships images to GKE TPU node pools, and ``dev`` keeps a live session open —
+agentless bidirectional file sync, port-forwarding, log streaming and
+terminals fanned out to every worker of a multi-host slice.
+
+Capability parity target: hoatle/devspace (see SURVEY.md). Architecture is
+TPU-first and brand new — JAX/pjit/shard_map/pallas for the compute layer,
+stdlib Kubernetes streams for the control plane.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,217 @@
+"""Canonical config schema, version ``tpu/v1``.
+
+Capability parity with the reference's latest schema
+(pkg/devspace/config/versions/latest/schema.go: Config{Version, Cluster, Dev,
+Deployments, Images}; DevConfig{Terminal, AutoReload, OverrideImages,
+Selectors, Ports, Sync}) plus the TPU-native additions: a ``tpu`` block
+describing the slice (accelerator type, worker count, topology) that charts
+and services consume, and per-sync fan-out policy across slice workers.
+
+Every field is Optional — "unset" is distinguishable from zero, mirroring the
+reference's pointer-field tri-state design.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+VERSION = "tpu/v1"
+
+
+# -- cluster ---------------------------------------------------------------
+@dataclass
+class ClusterUser:
+    client_cert: Optional[str] = None
+    client_key: Optional[str] = None
+    token: Optional[str] = None
+
+
+@dataclass
+class Cluster:
+    kube_context: Optional[str] = None
+    namespace: Optional[str] = None
+    api_server: Optional[str] = None
+    ca_cert: Optional[str] = None
+    user: Optional[ClusterUser] = None
+
+
+# -- tpu slice -------------------------------------------------------------
+@dataclass
+class TPUConfig:
+    """Describes the target TPU slice. Drives chart values
+    (google.com/tpu resource requests, worker replica count) and the
+    dev-session fan-out (one sync/terminal session per worker)."""
+
+    accelerator: Optional[str] = None  # e.g. "v5litepod-16"
+    topology: Optional[str] = None  # e.g. "4x4"
+    workers: Optional[int] = None  # hosts in the slice
+    chips_per_worker: Optional[int] = None
+    runtime_version: Optional[str] = None  # tpu-vm image/runtime
+
+
+# -- images ----------------------------------------------------------------
+@dataclass
+class BuildOptions:
+    build_args: Optional[Dict[str, str]] = None
+    target: Optional[str] = None
+    network: Optional[str] = None
+
+
+@dataclass
+class KanikoConfig:
+    cache: Optional[bool] = None
+    namespace: Optional[str] = None
+    pull_secret: Optional[str] = None
+    image: Optional[str] = None
+
+
+@dataclass
+class DockerConfig:
+    prefer_minikube: Optional[bool] = None
+    disable_fallback: Optional[bool] = None
+
+
+@dataclass
+class BuildConfig:
+    disabled: Optional[bool] = None
+    kaniko: Optional[KanikoConfig] = None
+    docker: Optional[DockerConfig] = None
+    options: Optional[BuildOptions] = None
+
+
+@dataclass
+class ImageConfig:
+    image: Optional[str] = None
+    tag: Optional[str] = None
+    dockerfile: Optional[str] = None
+    context: Optional[str] = None
+    create_pull_secret: Optional[bool] = None
+    insecure: Optional[bool] = None
+    skip_push: Optional[bool] = None
+    build: Optional[BuildConfig] = None
+
+
+# -- deployments -----------------------------------------------------------
+@dataclass
+class ChartConfig:
+    path: Optional[str] = None
+    name: Optional[str] = None
+    values: Optional[Dict[str, object]] = None
+    value_files: Optional[List[str]] = None
+    wait: Optional[bool] = None
+    timeout: Optional[int] = None
+
+
+@dataclass
+class ManifestsConfig:
+    paths: Optional[List[str]] = None
+
+
+@dataclass
+class DeploymentConfig:
+    name: Optional[str] = None
+    namespace: Optional[str] = None
+    chart: Optional[ChartConfig] = None
+    manifests: Optional[ManifestsConfig] = None
+
+
+# -- dev -------------------------------------------------------------------
+@dataclass
+class SelectorConfig:
+    name: Optional[str] = None
+    namespace: Optional[str] = None
+    label_selector: Optional[Dict[str, str]] = None
+    container_name: Optional[str] = None
+
+
+@dataclass
+class PortMapping:
+    local_port: Optional[int] = None
+    remote_port: Optional[int] = None
+    bind_address: Optional[str] = None
+
+
+@dataclass
+class PortForwardingConfig:
+    selector: Optional[str] = None
+    namespace: Optional[str] = None
+    label_selector: Optional[Dict[str, str]] = None
+    port_mappings: Optional[List[PortMapping]] = None
+    # TPU addition: forward from which worker (default 0); "all" offsets
+    # local ports by worker id so every host is reachable at once.
+    workers: Optional[str] = None
+
+
+@dataclass
+class BandwidthLimits:
+    download: Optional[int] = None  # KB/s
+    upload: Optional[int] = None
+
+
+@dataclass
+class SyncConfig:
+    selector: Optional[str] = None
+    namespace: Optional[str] = None
+    label_selector: Optional[Dict[str, str]] = None
+    container_name: Optional[str] = None
+    local_sub_path: Optional[str] = None
+    container_path: Optional[str] = None
+    exclude_paths: Optional[List[str]] = None
+    download_exclude_paths: Optional[List[str]] = None
+    upload_exclude_paths: Optional[List[str]] = None
+    bandwidth_limits: Optional[BandwidthLimits] = None
+    # TPU addition: "all" broadcasts uploads to every worker and treats
+    # worker 0 as authoritative for downloads; "worker0" syncs one host.
+    fan_out: Optional[str] = None
+
+
+@dataclass
+class TerminalConfig:
+    selector: Optional[str] = None
+    namespace: Optional[str] = None
+    label_selector: Optional[Dict[str, str]] = None
+    container_name: Optional[str] = None
+    command: Optional[List[str]] = None
+    disabled: Optional[bool] = None
+    # TPU addition: which worker to open the shell on (default 0).
+    worker: Optional[int] = None
+
+
+@dataclass
+class AutoReloadConfig:
+    paths: Optional[List[str]] = None
+    deployments: Optional[List[str]] = None
+    images: Optional[List[str]] = None
+    disabled: Optional[bool] = None
+
+
+@dataclass
+class ImageOverrideConfig:
+    name: Optional[str] = None
+    entrypoint: Optional[List[str]] = None
+
+
+@dataclass
+class DevConfig:
+    terminal: Optional[TerminalConfig] = None
+    auto_reload: Optional[AutoReloadConfig] = None
+    override_images: Optional[List[ImageOverrideConfig]] = None
+    selectors: Optional[List[SelectorConfig]] = None
+    ports: Optional[List[PortForwardingConfig]] = None
+    sync: Optional[List[SyncConfig]] = None
+
+
+# -- root ------------------------------------------------------------------
+@dataclass
+class Config:
+    version: Optional[str] = None
+    cluster: Optional[Cluster] = None
+    tpu: Optional[TPUConfig] = None
+    dev: Optional[DevConfig] = None
+    deployments: Optional[List[DeploymentConfig]] = None
+    images: Optional[Dict[str, ImageConfig]] = None
+
+
+def new() -> Config:
+    return Config(version=VERSION)

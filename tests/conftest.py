@@ -1,0 +1,17 @@
+"""Test session setup.
+
+JAX-touching tests run on a virtual 8-device CPU mesh (SURVEY §4: the
+reference's fake-backend trick generalized — fake a TPU slice with
+``xla_force_host_platform_device_count``). Env must be set before the first
+``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DEVSPACE_NONINTERACTIVE", "1")

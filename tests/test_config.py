@@ -1,0 +1,269 @@
+import os
+
+import pytest
+import yaml
+
+from devspace_tpu.config import latest, versions
+from devspace_tpu.config.generated import GeneratedConfig
+from devspace_tpu.config.loader import ConfigLoader, find_root, get_selector
+from devspace_tpu.config.merge import merge, split
+from devspace_tpu.config.structs import ConfigError, from_dict, to_dict
+from devspace_tpu.config.variables import resolve_vars
+
+
+LATEST_YAML = """
+version: tpu/v1
+cluster:
+  namespace: myns
+tpu:
+  accelerator: v5litepod-16
+  workers: 4
+images:
+  default:
+    image: gcr.io/proj/app
+deployments:
+  - name: app
+    chart:
+      path: ./chart
+dev:
+  selectors:
+    - name: default
+      labelSelector:
+        app: myapp
+  sync:
+    - selector: default
+      containerPath: /app
+      excludePaths: ["node_modules/"]
+  ports:
+    - selector: default
+      portMappings:
+        - localPort: 8888
+          remotePort: 8888
+"""
+
+
+def test_parse_latest():
+    cfg = versions.parse(yaml.safe_load(LATEST_YAML))
+    assert cfg.version == latest.VERSION
+    assert cfg.tpu.workers == 4
+    assert cfg.images["default"].image == "gcr.io/proj/app"
+    assert cfg.dev.sync[0].container_path == "/app"
+    assert get_selector(cfg, "default").label_selector == {"app": "myapp"}
+
+
+def test_unknown_key_rejected():
+    data = yaml.safe_load(LATEST_YAML)
+    data["bogus"] = 1
+    with pytest.raises(ConfigError, match="bogus"):
+        versions.parse(data)
+
+
+def test_missing_version_rejected():
+    with pytest.raises(ConfigError, match="version"):
+        versions.parse({"cluster": {}})
+
+
+def test_upgrade_chain_v1alpha1():
+    old = yaml.safe_load(
+        """
+version: tpu/v1alpha1
+deployments:
+  - name: app
+    autoReload: true
+    chart: {path: ./chart}
+sync:
+  - selector: default
+    containerPath: /app
+ports:
+  - selector: default
+    localPort: 8080
+    remotePort: 80
+terminal:
+  command: ["bash"]
+"""
+    )
+    cfg = versions.parse(old)
+    assert cfg.version == latest.VERSION
+    assert cfg.dev.sync[0].container_path == "/app"
+    assert cfg.dev.ports[0].port_mappings[0].local_port == 8080
+    assert cfg.dev.terminal.command == ["bash"]
+    assert cfg.dev.auto_reload.deployments == ["app"]
+    assert cfg.deployments[0].chart.path == "./chart"
+
+
+def test_roundtrip_to_dict():
+    cfg = versions.parse(yaml.safe_load(LATEST_YAML))
+    tree = to_dict(cfg)
+    cfg2 = from_dict(latest.Config, tree)
+    assert to_dict(cfg2) == tree
+
+
+def test_merge_semantics():
+    base = {"a": {"x": 1, "y": 2}, "list": [1, 2], "keep": "v"}
+    override = {"a": {"y": 3}, "list": [9]}
+    out = merge(base, override)
+    assert out == {"a": {"x": 1, "y": 3}, "list": [9], "keep": "v"}
+    # split is the inverse for the contributed parts
+    assert split(out, override) == {"a": {"x": 1}, "keep": "v"}
+
+
+def test_var_resolution(monkeypatch):
+    tree = {"image": "gcr.io/${project}/app:${tag}", "ns": "${project}"}
+    monkeypatch.setenv("DEVSPACE_VAR_PROJECT", "envproj")
+    cache = {"tag": "v1"}
+    out = resolve_vars(tree, cache, interactive=False)
+    assert out == {"image": "gcr.io/envproj/app:v1", "ns": "envproj"}
+
+
+def test_var_noninteractive_default(monkeypatch):
+    monkeypatch.delenv("DEVSPACE_VAR_NAME", raising=False)
+    cache = {}
+    out = resolve_vars({"v": "${name}"}, cache, interactive=False)
+    assert out == {"v": ""}
+    assert "name" in cache  # answer cached for next load
+
+
+def test_loader_end_to_end(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text(LATEST_YAML)
+    loader = ConfigLoader(str(root))
+    cfg = loader.load(interactive=False)
+    assert cfg.cluster.namespace == "myns"
+    # root discovery from a nested dir
+    nested = root / "src" / "deep"
+    nested.mkdir(parents=True)
+    assert find_root(str(nested)) == str(root)
+
+
+def test_loader_overrides(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text(LATEST_YAML)
+    (root / ".devspace" / "overrides.yaml").write_text(
+        "cluster:\n  namespace: overridden\n"
+    )
+    cfg = ConfigLoader(str(root)).load(interactive=False)
+    assert cfg.cluster.namespace == "overridden"
+
+
+def test_loader_multi_config(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / "base.yaml").write_text(LATEST_YAML)
+    (root / ".devspace" / "configs.yaml").write_text(
+        """
+default:
+  config: {path: base.yaml}
+staging:
+  config: {path: base.yaml}
+  overrides:
+    - config:
+        cluster: {namespace: staging}
+  vars:
+    - name: tag
+      default: stable
+"""
+    )
+    loader = ConfigLoader(str(root))
+    cfg = loader.load("staging", interactive=False)
+    assert cfg.cluster.namespace == "staging"
+    assert loader.generated.active_config == "staging"
+
+
+def test_validation_errors(tmp_path):
+    bad = yaml.safe_load(LATEST_YAML)
+    bad["dev"]["sync"][0]["selector"] = "nope"
+    root = tmp_path / "p"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text(yaml.safe_dump(bad))
+    with pytest.raises(ConfigError, match="unknown selector"):
+        ConfigLoader(str(root)).load(interactive=False)
+
+
+def test_generated_cache_roundtrip(tmp_path):
+    gc = GeneratedConfig(str(tmp_path))
+    cache = gc.get_cache(dev_mode=True)
+    cache.image_tags["default"] = "abc1234"
+    cache.dockerfile_context_hashes["default"] = "deadbeef"
+    gc.get_active().vars["tag"] = "v1"
+    gc.save()
+    gc2 = GeneratedConfig.load(str(tmp_path))
+    assert gc2.get_cache(True).image_tags["default"] == "abc1234"
+    assert gc2.get_active().vars["tag"] == "v1"
+    assert gc2.get_cache(False).image_tags == {}
+
+
+def test_save_preserves_var_placeholders(tmp_path, monkeypatch):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text(
+        "version: tpu/v1\ncluster:\n  namespace: ${project}-ns\n"
+    )
+    monkeypatch.setenv("DEVSPACE_VAR_PROJECT", "secretproj")
+    loader = ConfigLoader(str(root))
+    cfg = loader.load(interactive=False)
+    assert cfg.cluster.namespace == "secretproj-ns"
+    cfg.tpu = latest.TPUConfig(workers=2)  # a real edit
+    loader.save(cfg)
+    saved = (root / ".devspace" / "config.yaml").read_text()
+    assert "${project}-ns" in saved and "secretproj" not in saved
+    assert "workers: 2" in saved
+
+
+def test_save_multi_config_writes_referenced_file(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / "base.yaml").write_text(LATEST_YAML)
+    (root / ".devspace" / "configs.yaml").write_text(
+        "default:\n  config: {path: base.yaml}\n"
+    )
+    loader = ConfigLoader(str(root))
+    cfg = loader.load(interactive=False)
+    cfg.cluster.namespace = "edited"
+    loader.save(cfg)
+    assert "edited" in (root / "base.yaml").read_text()
+    assert not (root / ".devspace" / "config.yaml").exists()
+    # and the edit is visible on reload
+    assert ConfigLoader(str(root)).load(interactive=False).cluster.namespace == "edited"
+
+
+def test_stale_active_config_falls_back(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / "base.yaml").write_text(LATEST_YAML)
+    (root / ".devspace" / "configs.yaml").write_text(
+        "default:\n  config: {path: base.yaml}\n"
+    )
+    gc = GeneratedConfig(str(root))
+    gc.active_config = "deleted-config"
+    gc.save()
+    cfg = ConfigLoader(str(root)).load(interactive=False)  # must not raise
+    assert cfg.cluster.namespace == "myns"
+
+
+def test_noninteractive_var_with_pattern_errors(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / "base.yaml").write_text(LATEST_YAML.replace("myns", "${env}"))
+    (root / ".devspace" / "configs.yaml").write_text(
+        """
+default:
+  config: {path: base.yaml}
+  vars:
+    - name: env
+      regexPattern: "^(dev|prod)$"
+"""
+    )
+    with pytest.raises(ValueError, match="pattern"):
+        ConfigLoader(str(root)).load(interactive=False)
+
+
+def test_terminal_selector_validated(tmp_path):
+    bad = yaml.safe_load(LATEST_YAML)
+    bad["dev"]["terminal"] = {"selector": "nope"}
+    root = tmp_path / "p"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text(yaml.safe_dump(bad))
+    with pytest.raises(ConfigError, match="terminal.*unknown selector"):
+        ConfigLoader(str(root)).load(interactive=False)

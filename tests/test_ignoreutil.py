@@ -1,0 +1,47 @@
+from devspace_tpu.utils.ignoreutil import IgnoreMatcher
+
+
+def test_basic_patterns():
+    m = IgnoreMatcher(["*.log", "node_modules/", "/build", "# comment", ""])
+    assert m.matches("foo.log")
+    assert m.matches("sub/dir/foo.log")
+    assert not m.matches("foo.log.txt")
+    assert m.matches("node_modules", is_dir=True)
+    assert m.matches("node_modules/pkg/index.js")
+    assert not m.matches("node_modules")  # dir-only rule, leaf is a file
+    assert m.matches("build", is_dir=True)
+    assert m.matches("build/out.bin")
+    assert not m.matches("src/build/out.bin")  # anchored
+
+
+def test_negation_last_match_wins():
+    m = IgnoreMatcher(["*.log", "!keep.log"])
+    assert m.matches("debug.log")
+    assert not m.matches("keep.log")
+    m2 = IgnoreMatcher(["!keep.log", "*.log"])
+    assert m2.matches("keep.log")
+
+
+def test_doublestar():
+    m = IgnoreMatcher(["**/__pycache__/", "docs/**/*.tmp", "a/**"])
+    assert m.matches("__pycache__", is_dir=True)
+    assert m.matches("x/y/__pycache__", is_dir=True)
+    assert m.matches("x/__pycache__/mod.pyc")
+    assert m.matches("docs/a/b/file.tmp")
+    assert not m.matches("docs/file.tmp2")
+    assert m.matches("docs/x.tmp")  # ** matches zero dirs
+    assert m.matches("a/anything/below")
+
+
+def test_question_and_class():
+    m = IgnoreMatcher(["file?.txt", "data[0-9].csv"])
+    assert m.matches("file1.txt")
+    assert not m.matches("file12.txt")
+    assert m.matches("data5.csv")
+    assert not m.matches("dataX.csv")
+
+
+def test_everything_under_match():
+    m = IgnoreMatcher([".git"])
+    assert m.matches(".git", is_dir=True)
+    assert m.matches(".git/objects/ab/cd")

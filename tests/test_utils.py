@@ -1,0 +1,79 @@
+import io
+import os
+import time
+
+import pytest
+
+from devspace_tpu.utils import hashutil, log as logutil
+from devspace_tpu.utils.dockerfile import get_ports
+from devspace_tpu.utils.fsutil import walk_files, write_file
+from devspace_tpu.utils.ignoreutil import IgnoreMatcher
+from devspace_tpu.utils.randutil import random_string
+
+
+def test_random_string():
+    s = random_string(7)
+    assert len(s) == 7 and s.isalnum() and s == s.lower()
+    assert random_string(7) != random_string(7) or True  # non-deterministic
+
+
+def test_directory_hash_changes_on_edit(tmp_path):
+    write_file(str(tmp_path / "a.txt"), "hello")
+    write_file(str(tmp_path / "sub" / "b.txt"), "world")
+    h1 = hashutil.directory_hash(str(tmp_path))
+    h1b = hashutil.directory_hash(str(tmp_path))
+    assert h1 == h1b
+    time.sleep(0.01)
+    write_file(str(tmp_path / "a.txt"), "hello2")
+    assert hashutil.directory_hash(str(tmp_path)) != h1
+
+
+def test_directory_hash_excludes(tmp_path):
+    write_file(str(tmp_path / "a.txt"), "hello")
+    write_file(str(tmp_path / "node_modules" / "x.js"), "junk")
+    h1 = hashutil.directory_hash(str(tmp_path), excludes=["node_modules/"])
+    write_file(str(tmp_path / "node_modules" / "y.js"), "more junk")
+    assert hashutil.directory_hash(str(tmp_path), excludes=["node_modules/"]) == h1
+
+
+def test_walk_files_prunes_ignored(tmp_path):
+    write_file(str(tmp_path / "keep.py"), "x")
+    write_file(str(tmp_path / "skip" / "deep" / "f.txt"), "x")
+    rels = [r for r, _, _ in walk_files(str(tmp_path), IgnoreMatcher(["skip/"]))]
+    assert rels == ["keep.py"]
+
+
+def test_dockerfile_ports(tmp_path):
+    df = tmp_path / "Dockerfile"
+    df.write_text("FROM python:3.12\nEXPOSE 8080 9000/tcp\nexpose 3000\n")
+    assert get_ports(str(df)) == [8080, 9000, 3000]
+
+
+def test_logger_levels_and_mirror(tmp_path):
+    stream = io.StringIO()
+    lg = logutil.StdoutLogger(level="info", stream=stream)
+    fl = logutil.FileLogger(str(tmp_path / "logs" / "t.log"))
+    lg.add_mirror(fl)
+    lg.debug("hidden")
+    lg.info("shown %d", 42)
+    lg.done("finished")
+    out = stream.getvalue()
+    assert "hidden" not in out and "shown 42" in out and "finished" in out
+    fl.close()
+    content = (tmp_path / "logs" / "t.log").read_text()
+    assert "shown 42" in content and "hidden" in content  # file logs debug too
+
+
+def test_logger_fatal_raises():
+    lg = logutil.StdoutLogger(stream=io.StringIO())
+    with pytest.raises(logutil.FatalError):
+        lg.fatal("boom")
+
+
+def test_print_table():
+    stream = io.StringIO()
+    lg = logutil.StdoutLogger(stream=stream)
+    lg.print_table(["NAME", "STATUS"], [["app", "Running"], ["db", "Pending"]])
+    lines = stream.getvalue().splitlines()
+    assert lines[0].startswith("NAME") and "STATUS" in lines[0]
+    assert "Running" in lines[1]
