@@ -76,8 +76,16 @@ class GeneratedConfig:
         try:
             with open(gc.path, "r", encoding="utf-8") as fh:
                 data = yaml.safe_load(fh) or {}
+            return cls._parse(gc, data)
         except OSError:
             return gc
+        except Exception:
+            # State cache is advisory — a truncated/corrupt file must never
+            # brick every command; degrade to a fresh cache.
+            return cls(root)
+
+    @classmethod
+    def _parse(cls, gc: "GeneratedConfig", data: dict) -> "GeneratedConfig":
         gc.active_config = data.get("activeConfig", "default")
         for name, raw in (data.get("configs") or {}).items():
             cc = ConfigCache()

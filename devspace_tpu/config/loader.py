@@ -79,7 +79,6 @@ class ConfigLoader:
         cache = self.generated.get_active()
         tree = resolve_vars(tree, cache.vars, var_defs, interactive=interactive)
         cfg = versions.parse(tree)
-        self.apply_defaults(cfg)
         self.validate(cfg)
         return cfg
 
@@ -157,13 +156,10 @@ class ConfigLoader:
             return entry
         raise ConfigError(f"invalid configs.yaml entry: {entry!r}")
 
-    # -- defaults & validation -------------------------------------------
-    def apply_defaults(self, cfg: latest.Config) -> None:
-        if cfg.cluster is None:
-            cfg.cluster = latest.Cluster()
-        if cfg.cluster.namespace is None:
-            cfg.cluster.namespace = "default"
-
+    # -- validation -------------------------------------------------------
+    # Note: no defaults are injected into the config object — "unset" stays
+    # None (tri-state) so save() never bakes derived values into the user's
+    # file; consumers use get_default_namespace() and friends.
     def validate(self, cfg: latest.Config) -> None:
         """Reference: ValidateOnce (configutil/get.go:234)."""
         for i, d in enumerate(cfg.deployments or []):
@@ -214,9 +210,9 @@ class ConfigLoader:
         tree = to_dict(cfg)
         cache = self.generated.get_active().vars
         if self._override_tree:
-            resolved_override = resolve_vars(
-                copy.deepcopy(self._override_tree), cache, interactive=False
-            )
+            # Resolve override vars from env+cache only (never ask, never
+            # cache '' for unknowns) purely for value comparison in split().
+            resolved_override = _resolve_tree_known(self._override_tree, cache)
             tree = split(tree, resolved_override)
         if self._base_tree is not None:
             tree = _unresolve(tree, self._base_tree, cache)
@@ -226,6 +222,19 @@ class ConfigLoader:
 
     def save_generated(self) -> None:
         self.generated.save()
+
+
+def _resolve_tree_known(tree: Any, cache: dict[str, str]) -> Any:
+    """Substitute ${var} from env+cache only; unknown vars keep their
+    placeholder (they then simply won't match during split comparison)."""
+    if isinstance(tree, dict):
+        return {k: _resolve_tree_known(v, cache) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_resolve_tree_known(v, cache) for v in tree]
+    if isinstance(tree, str) and _VAR_RE.search(tree):
+        resolved = substitute_known(tree, cache)
+        return resolved if resolved is not None else tree
+    return tree
 
 
 def _unresolve(new: Any, base: Any, cache: dict[str, str]) -> Any:

@@ -53,9 +53,10 @@ def ask(q: Question, logger=None, interactive: Optional[bool] = None) -> str:
             prompt += f" [{q.default}]"
         sys.stderr.write(prompt + ": ")
         sys.stderr.flush()
-        answer = sys.stdin.readline().rstrip("\n")
-        if not answer:
-            answer = q.default
+        line = sys.stdin.readline()
+        if line == "":  # EOF — a blank line would be "\n"
+            raise EOFError(f"stdin closed while asking: {q.question!r}")
+        answer = line.rstrip("\n") or q.default
         if q.options and answer not in q.options:
             sys.stderr.write(f"Please answer one of: {', '.join(q.options)}\n")
             continue

@@ -267,3 +267,25 @@ def test_terminal_selector_validated(tmp_path):
     (root / ".devspace" / "config.yaml").write_text(yaml.safe_dump(bad))
     with pytest.raises(ConfigError, match="terminal.*unknown selector"):
         ConfigLoader(str(root)).load(interactive=False)
+
+
+def test_corrupt_generated_yaml_degrades(tmp_path):
+    d = tmp_path / ".devspace"
+    d.mkdir()
+    (d / "generated.yaml").write_text("configs:\n  default:\n")  # null cache
+    gc = GeneratedConfig.load(str(tmp_path))
+    assert gc.get_active() is not None
+    (d / "generated.yaml").write_text("{{{{not yaml")
+    gc = GeneratedConfig.load(str(tmp_path))
+    assert gc.active_config == "default"
+
+
+def test_save_does_not_bake_defaults(tmp_path):
+    root = tmp_path / "proj"
+    (root / ".devspace").mkdir(parents=True)
+    (root / ".devspace" / "config.yaml").write_text("version: tpu/v1\n")
+    loader = ConfigLoader(str(root))
+    cfg = loader.load(interactive=False)
+    loader.save(cfg)
+    saved = yaml.safe_load((root / ".devspace" / "config.yaml").read_text())
+    assert "cluster" not in saved

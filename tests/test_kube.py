@@ -1,0 +1,320 @@
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from devspace_tpu.kube import websocket as ws
+from devspace_tpu.kube.client import Pod, get_pod_status
+from devspace_tpu.kube.fake import FakeCluster
+from devspace_tpu.kube.kubeconfig import ClusterInfo, ContextInfo, KubeConfig, UserInfo
+from devspace_tpu.kube.portforward import PortForwarder
+from devspace_tpu.kube.streams import StreamBuffer, StreamClosed, SubprocessRemoteProcess
+from devspace_tpu.kube.transport import ApiError, KubeTransport
+
+
+# -- kubeconfig -------------------------------------------------------------
+def test_kubeconfig_roundtrip(tmp_path):
+    kc = KubeConfig(path=str(tmp_path / "config"))
+    kc.clusters["c1"] = ClusterInfo(server="https://1.2.3.4:6443", ca_data=b"PEM")
+    kc.users["u1"] = UserInfo(token="tok123")
+    kc.contexts["ctx1"] = ContextInfo(cluster="c1", user="u1", namespace="ns1")
+    kc.current_context = "ctx1"
+    kc.save()
+    kc2 = KubeConfig.load(str(tmp_path / "config"))
+    cluster, user, ctx = kc2.resolve()
+    assert cluster.server == "https://1.2.3.4:6443"
+    assert cluster.ca_data == b"PEM"
+    assert user.token == "tok123"
+    assert ctx.namespace == "ns1"
+
+
+def test_kubeconfig_missing_context():
+    kc = KubeConfig()
+    with pytest.raises(KeyError):
+        kc.resolve("nope")
+
+
+# -- websocket loopback -----------------------------------------------------
+def _ws_pair():
+    """Connected (client WebSocket, server WebSocket) over a socketpair-like
+    local TCP connection with a real handshake."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    result = {}
+
+    def server():
+        conn, _ = lsock.accept()
+        ws.server_handshake(conn)
+        result["server"] = ws.WebSocket(conn, is_client=False)
+
+    t = threading.Thread(target=server)
+    t.start()
+    csock = socket.create_connection(("127.0.0.1", port))
+    proto = ws.client_handshake(csock, "127.0.0.1", "/", subprotocols=["v4.channel.k8s.io"])
+    t.join()
+    lsock.close()
+    assert proto == "v4.channel.k8s.io"
+    return ws.WebSocket(csock, is_client=True), result["server"]
+
+
+def test_websocket_echo_and_large_frames():
+    client, server = _ws_pair()
+    client.send(b"hello")
+    op, payload = server.recv_message()
+    assert payload == b"hello"
+    big = bytes(range(256)) * 1024  # 256 KiB -> 64-bit length path
+    server.send(big)
+    op, payload = client.recv_message()
+    assert payload == big
+    client.close()
+    server.close()
+
+
+def test_websocket_ping_handled_transparently():
+    client, server = _ws_pair()
+    server.send(b"ping-me", ws.OP_PING)
+    server.send(b"data")
+    op, payload = client.recv_message()
+    assert payload == b"data"
+    # client auto-answered the ping
+    op, payload, fin = server.recv_frame()
+    assert op == ws.OP_PONG and payload == b"ping-me"
+    client.close()
+    server.close()
+
+
+# -- stream buffers ---------------------------------------------------------
+def test_stream_buffer_read_until_and_exact():
+    buf = StreamBuffer()
+    buf.feed(b"abcSTART123")
+    before, token = buf.read_until([b"START"], timeout=1)
+    assert before == b"abc" and token == b"START"
+    assert buf.read_exact(3, timeout=1) == b"123"
+    buf.close()
+    with pytest.raises(StreamClosed):
+        buf.read_exact(1, timeout=1)
+
+
+def test_stream_buffer_timeout():
+    buf = StreamBuffer()
+    with pytest.raises(TimeoutError):
+        buf.read_until([b"X"], timeout=0.05)
+
+
+def test_subprocess_remote_process():
+    proc = SubprocessRemoteProcess(["sh"])
+    proc.write_stdin(b"echo hello; echo err >&2\n")
+    out, _ = proc.stdout.read_until([b"\n"], timeout=5)
+    assert out == b"hello"
+    err, _ = proc.stderr.read_until([b"\n"], timeout=5)
+    assert err == b"err"
+    proc.write_stdin(b"exit 3\n")
+    assert proc.wait(5) == 3
+
+
+# -- pod status -------------------------------------------------------------
+def _pod(status):
+    return Pod({"metadata": {"name": "p"}, "spec": {}, "status": status})
+
+
+def test_pod_status_derivation():
+    assert get_pod_status(_pod({"phase": "Pending"})) == "Pending"
+    assert (
+        get_pod_status(
+            _pod(
+                {
+                    "phase": "Running",
+                    "containerStatuses": [{"ready": True, "state": {"running": {}}}],
+                }
+            )
+        )
+        == "Running"
+    )
+    assert (
+        get_pod_status(
+            _pod(
+                {
+                    "phase": "Running",
+                    "containerStatuses": [
+                        {
+                            "ready": False,
+                            "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+                        }
+                    ],
+                }
+            )
+        )
+        == "CrashLoopBackOff"
+    )
+    terminating = Pod(
+        {
+            "metadata": {"name": "p", "deletionTimestamp": "2026-01-01T00:00:00Z"},
+            "status": {"phase": "Running"},
+        }
+    )
+    assert get_pod_status(terminating) == "Terminating"
+
+
+# -- transport REST against local http server -------------------------------
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/api/v1/namespaces/default/pods"):
+            body = json.dumps(
+                {
+                    "items": [
+                        {
+                            "metadata": {
+                                "name": "w-1",
+                                "namespace": "default",
+                                "labels": {"app": "x"},
+                                "creationTimestamp": "2026-01-01T00:00:01Z",
+                            },
+                            "status": {"phase": "Running", "containerStatuses": [{"ready": True, "state": {}}]},
+                            "spec": {"containers": [{"name": "main", "env": [{"name": "TPU_WORKER_ID", "value": "1"}]}]},
+                        },
+                        {
+                            "metadata": {
+                                "name": "w-0",
+                                "namespace": "default",
+                                "labels": {"app": "x"},
+                                "creationTimestamp": "2026-01-01T00:00:00Z",
+                            },
+                            "status": {"phase": "Running", "containerStatuses": [{"ready": True, "state": {}}]},
+                            "spec": {"containers": [{"name": "main", "env": [{"name": "TPU_WORKER_ID", "value": "0"}]}]},
+                        },
+                    ]
+                }
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            body = json.dumps({"message": "not found"}).encode()
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(body)
+
+
+@pytest.fixture
+def http_api():
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_transport_rest_and_slice_ordering(http_api):
+    from devspace_tpu.kube.client import KubeClient
+
+    client = KubeClient(KubeTransport(http_api, token="t"))
+    pods = client.list_pods()
+    assert {p.name for p in pods} == {"w-0", "w-1"}
+    workers = client.slice_workers({"app": "x"}, timeout=5)
+    assert [p.name for p in workers] == ["w-0", "w-1"]
+    assert [p.tpu_worker_id for p in workers] == [0, 1]
+    with pytest.raises(ApiError) as ei:
+        client.transport.request("GET", "/nope")
+    assert ei.value.status == 404
+
+
+# -- fake cluster -----------------------------------------------------------
+def test_fake_cluster_pods_and_exec(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("w-0", labels={"app": "t"}, worker_id=0)
+    fc.add_pod("w-1", labels={"app": "t"}, worker_id=1)
+    workers = fc.slice_workers({"app": "t"}, expected=2, timeout=5)
+    assert [p.tpu_worker_id for p in workers] == [0, 1]
+    out, err, rc = fc.exec_buffered("w-0", ["sh", "-c", "echo hi"])
+    assert rc == 0 and out.strip() == b"hi"
+    # exec runs inside the pod's dir
+    out, _, _ = fc.exec_buffered("w-0", ["pwd"])
+    assert out.decode().strip() == fc.pod_dir("w-0")
+
+
+def test_fake_cluster_apply_synthesizes_slice(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    fc.apply(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": "trainer"},
+            "spec": {
+                "replicas": 4,
+                "template": {
+                    "metadata": {"labels": {"app": "trainer"}},
+                    "spec": {"containers": [{"name": "main"}]},
+                },
+            },
+        }
+    )
+    workers = fc.slice_workers({"app": "trainer"}, expected=4, timeout=5)
+    assert [p.tpu_worker_id for p in workers] == [0, 1, 2, 3]
+    fc.delete_object({"kind": "StatefulSet", "metadata": {"name": "trainer"}})
+    assert fc.list_pods(label_selector={"app": "trainer"}) == []
+
+
+def test_fake_portforward_roundtrip(tmp_path):
+    # local echo server standing in for the in-pod server
+    echo = socket.socket()
+    echo.bind(("127.0.0.1", 0))
+    echo.listen(1)
+
+    def serve():
+        conn, _ = echo.accept()
+        data = conn.recv(1024)
+        conn.sendall(b"echo:" + data)
+        conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("srv")
+    fc.expose_port("srv", 8080, echo.getsockname()[1])
+    fw = fc.portforward("srv", [(0, 8080)])  # 0 -> ephemeral local port
+    fw.start()
+    assert fw.ready.wait(5)
+    local = fw.local_ports[0]
+    with socket.create_connection(("127.0.0.1", local), timeout=5) as s:
+        s.sendall(b"ping")
+        assert s.recv(1024) == b"echo:ping"
+    fw.stop()
+    echo.close()
+
+
+def test_ws_exec_channel_demux():
+    """Loopback server speaking v4.channel.k8s.io: stdout/stderr/error-status
+    frames demuxed by WSRemoteProcess."""
+    from devspace_tpu.kube.exec import WSRemoteProcess
+
+    client, server = _ws_pair()
+    proc = WSRemoteProcess(client)
+
+    server.send(bytes([1]) + b"out-data")
+    server.send(bytes([2]) + b"err-data")
+    # stdin from the client arrives on channel 0
+    proc.write_stdin(b"input")
+    op, payload = server.recv_message()
+    assert payload == bytes([0]) + b"input"
+    # error channel carries a v1.Status with exit code
+    status = json.dumps(
+        {
+            "status": "Failure",
+            "reason": "NonZeroExitCode",
+            "details": {"causes": [{"reason": "ExitCode", "message": "42"}]},
+        }
+    ).encode()
+    server.send(bytes([3]) + status)
+    assert proc.stdout.read_exact(8, timeout=5) == b"out-data"
+    assert proc.stderr.read_exact(8, timeout=5) == b"err-data"
+    server.close()
+    assert proc.wait(5) == 42
