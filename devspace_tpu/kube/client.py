@@ -133,7 +133,7 @@ def get_pod_status(pod: Pod) -> str:
             )
     if status.get("phase") == "Running":
         ready = all(
-            cs.get("ready") for cs in status.get("containerStatuses") or [None]
+            (cs or {}).get("ready") for cs in status.get("containerStatuses") or []
         )
         if reason in ("Running", pod.phase) and ready:
             return "Running"
@@ -262,7 +262,15 @@ class KubeClient:
         while True:
             pods = self.list_pods(namespace, label_selector)
             running = [p for p in pods if get_pod_status(p) == "Running"]
-            want = expected if expected is not None else (len(pods) or 1)
+            # Only pods that can still become Running count toward the target
+            # (a Completed init Job or Terminating predecessor must not).
+            active = [
+                p
+                for p in pods
+                if get_pod_status(p)
+                not in ("Succeeded", "Completed", "Terminating")
+            ]
+            want = expected if expected is not None else (len(active) or 1)
             if len(running) >= want and running:
                 running.sort(
                     key=lambda p: (

@@ -39,6 +39,7 @@ class WSRemoteProcess(RemoteProcess):
         self._reader.start()
 
     def _read_loop(self) -> None:
+        aborted = False
         try:
             while True:
                 opcode, payload = self.ws.recv_message()
@@ -54,10 +55,16 @@ class WSRemoteProcess(RemoteProcess):
                 elif channel == CH_ERROR:
                     self._error_payload += data
         except WebSocketError:
-            pass
+            aborted = True
         finally:
             with self._status_lock:
-                self._status = self._parse_status()
+                if aborted and not self._error_payload:
+                    # Connection dropped before the kubelet sent a status —
+                    # this is NOT success; callers must not trust partial
+                    # output (e.g. the sync shell protocol).
+                    self._status = -1
+                else:
+                    self._status = self._parse_status()
             self.stdout.close()
             self.stderr.close()
 

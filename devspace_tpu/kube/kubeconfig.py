@@ -140,6 +140,8 @@ class KubeConfig:
                     "name": name,
                     "user": {
                         **({"token": u.token} if u.token else {}),
+                        **({"username": u.username} if u.username else {}),
+                        **({"password": u.password} if u.password else {}),
                         **(
                             {
                                 "client-certificate-data": base64.b64encode(

@@ -139,10 +139,9 @@ class WSPortTunnel:
             query=[("ports", str(port))],
             subprotocols=["v4.channel.k8s.io"],
         )
-        self._recv_buf = b""
-        self._port_frames_seen = 0
         # The first frame on each channel (data=0, error=1) is a 2-byte
         # little-endian confirmation of the port number.
+        self._confirmed: set[int] = set()
 
     def send(self, data: bytes) -> None:
         self.ws.send(bytes([0]) + data)
@@ -155,11 +154,13 @@ class WSPortTunnel:
             if not payload:
                 continue
             channel, data = payload[0], payload[1:]
-            if self._port_frames_seen < 2 and len(data) == 2:
-                # Port confirmation frame for this channel.
-                (port,) = struct.unpack("<H", data)
-                self._port_frames_seen += 1
-                continue
+            if channel not in self._confirmed:
+                # Port confirmation frame — strictly the first frame per
+                # channel, so a real 2-byte payload is never swallowed.
+                self._confirmed.add(channel)
+                if len(data) == 2:
+                    struct.unpack("<H", data)
+                    continue
             if channel == 0:
                 return data
             if channel == 1 and data:

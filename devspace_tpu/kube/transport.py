@@ -246,7 +246,7 @@ class KubeTransport:
             if query:
                 full += "?" + urllib.parse.urlencode(query)
             ws_host = self.host if self.port in (80, 443) else f"{self.host}:{self.port}"
-            ws.client_handshake(
+            _, prebuffer = ws.client_handshake(
                 raw,
                 ws_host,
                 full,
@@ -254,7 +254,7 @@ class KubeTransport:
                 subprotocols=subprotocols or ["v4.channel.k8s.io"],
             )
             raw.settimeout(None)
-            return ws.WebSocket(raw, is_client=True)
+            return ws.WebSocket(raw, is_client=True, prebuffer=prebuffer)
         except BaseException:
             raw.close()
             raise

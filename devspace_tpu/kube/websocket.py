@@ -46,9 +46,10 @@ def client_handshake(
     path: str,
     headers: Optional[dict[str, str]] = None,
     subprotocols: Optional[list[str]] = None,
-) -> Optional[str]:
-    """Perform the client upgrade handshake; returns the accepted
-    subprotocol (or None). Raises WebSocketError on refusal."""
+) -> tuple[Optional[str], bytes]:
+    """Perform the client upgrade handshake; returns (accepted subprotocol,
+    leftover frame bytes that arrived coalesced with the 101 response — pass
+    them to WebSocket(prebuffer=...)). Raises WebSocketError on refusal."""
     key = base64.b64encode(os.urandom(16)).decode()
     lines = [
         f"GET {path} HTTP/1.1",
@@ -85,10 +86,7 @@ def client_handshake(
             resp_headers[k.strip().lower()] = v.strip()
     if resp_headers.get("sec-websocket-accept") != accept_key(key):
         raise WebSocketError("bad Sec-WebSocket-Accept")
-    if rest:
-        # Leftover bytes already belong to the frame stream.
-        sock._ws_prebuffer = rest  # type: ignore[attr-defined]
-    return resp_headers.get("sec-websocket-protocol")
+    return resp_headers.get("sec-websocket-protocol"), rest
 
 
 def encode_frame(opcode: int, payload: bytes, mask: bool = True, fin: bool = True) -> bytes:
@@ -110,10 +108,12 @@ def encode_frame(opcode: int, payload: bytes, mask: bool = True, fin: bool = Tru
 class WebSocket:
     """Blocking WebSocket endpoint over a connected (TLS) socket."""
 
-    def __init__(self, sock: socket.socket, is_client: bool = True):
+    def __init__(
+        self, sock: socket.socket, is_client: bool = True, prebuffer: bytes = b""
+    ):
         self.sock = sock
         self.is_client = is_client
-        self._buffer = getattr(sock, "_ws_prebuffer", b"") or b""
+        self._buffer = prebuffer
         self._closed = False
 
     # -- raw io -----------------------------------------------------------
@@ -200,9 +200,9 @@ class WebSocket:
 
 
 # -- server-side helpers (tests' loopback server) --------------------------
-def server_handshake(sock: socket.socket) -> Optional[str]:
-    """Accept a client upgrade on a connected socket; returns the requested
-    first subprotocol (echoed back)."""
+def server_handshake(sock: socket.socket) -> tuple[Optional[str], bytes]:
+    """Accept a client upgrade on a connected socket; returns (first requested
+    subprotocol — echoed back, leftover frame bytes)."""
     head = b""
     while b"\r\n\r\n" not in head:
         chunk = sock.recv(4096)
@@ -226,6 +226,4 @@ def server_handshake(sock: socket.socket) -> Optional[str]:
     if proto:
         lines.append(f"Sec-WebSocket-Protocol: {proto}")
     sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
-    if rest:
-        sock._ws_prebuffer = rest  # type: ignore[attr-defined]
-    return proto
+    return proto, rest

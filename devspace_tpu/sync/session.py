@@ -1,0 +1,634 @@
+"""Bidirectional sync session with N-worker TPU-slice fan-out.
+
+Reference behavior (pkg/devspace/sync/sync_config.go + upstream.go +
+downstream.go + evaluater.go), generalized per SURVEY §2.2's TPU-build note:
+one local watcher feeds an upstream that broadcasts to every slice worker;
+the downstream polls worker 0 (authoritative). Conflict rules preserved:
+
+- steady-state upload on any local mtime+size change (evaluater.go:37)
+- download when the remote side is newer than the index (evaluater.go:91)
+- initial sync keeps the newer side, never deletes (sync_config.go:262)
+- remote deletions propagate only after two stable polls AND the local
+  file still matches the index — the deletion triple-check
+  (downstream.go:105-134, evaluater.go:139)
+- uploads that race a remote-newer file are skipped (shouldRemoveRemote
+  mtime guard, evaluater.go:8)
+
+Latency: defaults beat the reference's constants (~1s upstream debounce,
+1.3s downstream poll — BASELINE.md) while keeping the same safety rules.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils import log as logutil
+from ..utils.ignoreutil import IgnoreMatcher
+from .file_info import FileInformation, local_file_information
+from .index import FileIndex
+from .shell import RateLimiter, RemoteShell, SyncError, build_tar, extract_tar
+from .watcher import Watcher, new_watcher
+
+UPLOAD_BATCH_FILES = 1000  # reference: sync_config.go:20
+UPLOAD_BATCH_BYTES = 64 << 20
+
+
+@dataclass
+class SyncOptions:
+    local_path: str
+    container_path: str
+    exclude_paths: list[str] = field(default_factory=list)
+    download_exclude_paths: list[str] = field(default_factory=list)
+    upload_exclude_paths: list[str] = field(default_factory=list)
+    upload_limit_kbs: Optional[int] = None
+    download_limit_kbs: Optional[int] = None
+    # Latency knobs — defaults beat the reference's 1s/600ms/1.3s.
+    upstream_quiet: float = 0.25
+    upstream_tick: float = 0.05
+    downstream_interval: float = 0.8
+    stable_polls: int = 2  # reference: downstream.go:117-128
+    container: Optional[str] = None
+    fan_out: str = "all"  # "all" | "worker0"
+    verbose: bool = False
+
+
+class SyncSession:
+    def __init__(
+        self,
+        backend,
+        workers: list,
+        options: SyncOptions,
+        logger: Optional[logutil.Logger] = None,
+    ):
+        if not workers:
+            raise ValueError("sync session needs at least one worker pod")
+        self.backend = backend
+        self.workers = workers if options.fan_out == "all" else workers[:1]
+        self.opts = options
+        self.log = logger or logutil.get_logger()
+        self.index = FileIndex()
+        self.error: Optional[BaseException] = None
+        self._stopped = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._shells: list[RemoteShell] = []  # upstream shell per worker
+        self._down_shell: Optional[RemoteShell] = None
+        self._watcher: Optional[Watcher] = None
+        self._last_remote: dict[str, FileInformation] = {}
+        self._last_remote_lock = threading.Lock()
+        self._up_limiter = RateLimiter(options.upload_limit_kbs)
+        self._down_limiter = RateLimiter(options.download_limit_kbs)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, len(self.workers)), thread_name_prefix="sync-up"
+        )
+        combined = list(options.exclude_paths)
+        self.exclude = IgnoreMatcher(combined)
+        self.upload_exclude = IgnoreMatcher(
+            combined + list(options.upload_exclude_paths)
+        )
+        self.download_exclude = IgnoreMatcher(
+            combined + list(options.download_exclude_paths)
+        )
+        # Stats for `status sync` (reference scrapes sync.log; we keep
+        # counters AND log lines).
+        self.stats = {"uploaded": 0, "downloaded": 0, "removed_local": 0, "removed_remote": 0}
+        self.started_at: Optional[float] = None
+        self.initial_sync_done = threading.Event()
+
+    # -- paths -------------------------------------------------------------
+    def _remote_dir(self, worker) -> str:
+        return self.backend.translate_path(worker, self.opts.container_path)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Open shells, run initial sync, then start the pipes
+        (reference: sync_config.go Start/mainLoop)."""
+        self.started_at = time.time()
+        self.log.info(
+            "[sync] starting: %s <-> %s on %d worker(s)",
+            self.opts.local_path,
+            self.opts.container_path,
+            len(self.workers),
+        )
+        for w in self.workers:
+            proc = self.backend.exec_stream(
+                w, ["sh"], container=self.opts.container, tty=False
+            )
+            self._shells.append(RemoteShell(proc, label=f"up{getattr(w, 'name', w)}"))
+        down_proc = self.backend.exec_stream(
+            self.workers[0], ["sh"], container=self.opts.container, tty=False
+        )
+        self._down_shell = RemoteShell(down_proc, label="down")
+
+        # Watcher starts BEFORE initial sync so changes made during it are
+        # not lost (events for files initial-sync touches are deduped by the
+        # index check).
+        self._watcher = new_watcher(self.opts.local_path, self.upload_exclude)
+        self._watcher.start()
+
+        self.initial_sync()
+        self.initial_sync_done.set()
+
+        t_up = threading.Thread(target=self._upstream_loop, daemon=True, name="sync-upstream")
+        t_down = threading.Thread(target=self._downstream_loop, daemon=True, name="sync-downstream")
+        self._threads = [t_up, t_down]
+        t_up.start()
+        t_down.start()
+
+    def stop(self, error: Optional[BaseException] = None) -> None:
+        if error is not None and self.error is None:
+            self.error = error
+            self.log.error("[sync] fatal: %s", error)
+        self._stopped.set()
+        if self._watcher:
+            self._watcher.stop()
+        for sh in self._shells:
+            sh.close()
+        if self._down_shell:
+            self._down_shell.close()
+        self._pool.shutdown(wait=False)
+
+    # -- local walk --------------------------------------------------------
+    def _walk_local(self) -> dict[str, FileInformation]:
+        out: dict[str, FileInformation] = {}
+        root = self.opts.local_path
+        stack = [root]
+        seen_dirs: set[tuple[int, int]] = set()
+        while stack:
+            d = stack.pop()
+            try:
+                with os.scandir(d) as it:
+                    entries = list(it)
+            except OSError:
+                continue
+            for e in entries:
+                rel = os.path.relpath(e.path, root).replace(os.sep, "/")
+                try:
+                    is_dir = e.is_dir()  # follows symlinks
+                except OSError:
+                    continue
+                if self.exclude.matches(rel, is_dir):
+                    continue
+                info = local_file_information(root, rel)
+                if info is None:
+                    continue
+                out[rel] = info
+                if is_dir:
+                    try:
+                        st = os.stat(e.path)
+                        key = (st.st_dev, st.st_ino)
+                    except OSError:
+                        continue
+                    if key in seen_dirs:
+                        continue  # symlink cycle guard
+                    seen_dirs.add(key)
+                    stack.append(e.path)
+        return out
+
+    # -- initial sync ------------------------------------------------------
+    def initial_sync(self) -> None:
+        """Reconcile both sides, newest wins, no deletions
+        (reference: sync_config.go initialSync/diffServerClient)."""
+        assert self._down_shell is not None
+        remote = self._down_shell.snapshot(self._remote_dir(self.workers[0]))
+        local = self._walk_local()
+
+        uploads: list[FileInformation] = []
+        downloads: list[str] = []
+        for rel, li in local.items():
+            ri = remote.get(rel)
+            if li.is_directory:
+                if ri is None and not self.upload_exclude.matches(rel, True):
+                    uploads.append(li)
+                else:
+                    self.index.set(li)
+                continue
+            if ri is None:
+                if not self.upload_exclude.matches(rel, False):
+                    uploads.append(li)
+            elif li.same_as(ri):
+                li.remote_mode = ri.remote_mode
+                li.remote_uid = ri.remote_uid
+                li.remote_gid = ri.remote_gid
+                self.index.set(li)
+            elif ri.mtime > li.mtime and not self.download_exclude.matches(rel, False):
+                downloads.append(rel)
+            elif not self.upload_exclude.matches(rel, False):
+                li.remote_mode = ri.remote_mode
+                li.remote_uid = ri.remote_uid
+                li.remote_gid = ri.remote_gid
+                uploads.append(li)
+        for rel, ri in remote.items():
+            if rel not in local and not ri.is_directory:
+                if not self.exclude.matches(rel, False) and not self.download_exclude.matches(rel, False):
+                    downloads.append(rel)
+
+        if downloads:
+            self._apply_downloads(downloads)
+        if uploads:
+            self._apply_uploads(uploads, self._shells, self.workers)
+
+        # Mirror pass for non-authoritative workers: bring each to local
+        # state (upload-only — initial sync never deletes).
+        if len(self.workers) > 1:
+            local_now = self._walk_local()
+
+            def mirror(i: int) -> None:
+                shell = self._shells[i]
+                w = self.workers[i]
+                snap = shell.snapshot(self._remote_dir(w))
+                need = [
+                    li
+                    for rel, li in local_now.items()
+                    if not self.upload_exclude.matches(rel, li.is_directory)
+                    and (rel not in snap or (not li.is_directory and not li.same_as(snap[rel])))
+                ]
+                if need:
+                    self._upload_to(shell, w, need)
+
+            futures = [self._pool.submit(mirror, i) for i in range(1, len(self.workers))]
+            for f in futures:
+                f.result()
+        self.log.done(
+            "[sync] initial sync complete: %d up, %d down, index=%d",
+            len(uploads),
+            len(downloads),
+            len(self.index),
+        )
+
+    # -- upstream ----------------------------------------------------------
+    def _upstream_loop(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                changes = self._collect_events()
+                if changes is None:
+                    continue
+                if self._stopped.is_set():
+                    return
+                self._process_upstream_changes(changes)
+        except BaseException as e:  # noqa: BLE001 — any pipe error is fatal
+            if not self._stopped.is_set():
+                self.stop(e)
+
+    def _collect_events(self) -> Optional[set[str]]:
+        """Debounce: gather events until a quiet period passes
+        (reference: upstream.go mainLoop 100-153)."""
+        import queue as queue_mod
+
+        assert self._watcher is not None
+        try:
+            first = self._watcher.events.get(timeout=self.opts.upstream_tick)
+        except queue_mod.Empty:
+            return None
+        changes = {first}
+        last_event = time.monotonic()
+        while not self._stopped.is_set():
+            try:
+                ev = self._watcher.events.get(timeout=self.opts.upstream_tick)
+                changes.add(ev)
+                last_event = time.monotonic()
+            except queue_mod.Empty:
+                if time.monotonic() - last_event >= self.opts.upstream_quiet:
+                    break
+        if self._watcher.overflowed.is_set():
+            self._watcher.overflowed.clear()
+            self.log.warn("[sync] event overflow — full rescan")
+            local = self._walk_local()
+            changes.update(local.keys())
+            changes.update(self.index.snapshot().keys())
+        return changes
+
+    def _process_upstream_changes(self, changes: set[str]) -> None:
+        """Classify by stat (reference: evaluateChange) then apply."""
+        creates: list[FileInformation] = []
+        removes: list[str] = []
+        expanded: set[str] = set()
+        for rel in sorted(changes):
+            if rel in expanded:
+                continue
+            li = local_file_information(self.opts.local_path, rel)
+            if li is None:
+                old = self.index.get(rel)
+                if old is not None and not self.upload_exclude.matches(
+                    rel, old.is_directory
+                ):
+                    if self._remote_newer_than_index(rel):
+                        continue  # remote changed it meanwhile — downstream wins
+                    removes.append(rel)
+                continue
+            if self.upload_exclude.matches(rel, li.is_directory):
+                continue
+            if li.is_directory:
+                if rel not in self.index:
+                    # New dir: upload it and everything beneath.
+                    sub = self._walk_subtree(rel)
+                    creates.extend(sub)
+                    expanded.update(i.name for i in sub)
+                continue
+            old = self.index.get(rel)
+            if old is None or not li.same_as(old):
+                if old is not None:
+                    li.remote_mode = old.remote_mode
+                    li.remote_uid = old.remote_uid
+                    li.remote_gid = old.remote_gid
+                creates.append(li)
+        if removes:
+            self._apply_removes(removes)
+        if creates:
+            self._apply_uploads(creates, self._shells, self.workers)
+
+    def _walk_subtree(self, rel: str) -> list[FileInformation]:
+        root = self.opts.local_path
+        out: list[FileInformation] = []
+        top = local_file_information(root, rel)
+        if top is not None:
+            out.append(top)
+        full = os.path.join(root, rel.replace("/", os.sep))
+        for dirpath, dirnames, filenames in os.walk(full):
+            for name in dirnames + filenames:
+                sub = os.path.relpath(os.path.join(dirpath, name), root).replace(
+                    os.sep, "/"
+                )
+                is_dir = name in dirnames
+                if self.upload_exclude.matches(sub, is_dir):
+                    if is_dir:
+                        dirnames.remove(name)
+                    continue
+                info = local_file_information(root, sub)
+                if info is not None:
+                    out.append(info)
+        return out
+
+    def _remote_newer_than_index(self, rel: str) -> bool:
+        """Upload/remove safety valve (reference: evaluater.go:8
+        shouldRemoveRemote's mtime guard): consult the latest downstream
+        snapshot; if the remote copy is newer than our index, don't clobber."""
+        idx = self.index.get(rel)
+        with self._last_remote_lock:
+            remote = self._last_remote.get(rel)
+        if idx is None or remote is None:
+            return False
+        return remote.mtime > idx.mtime
+
+    def _apply_uploads(
+        self, entries: list[FileInformation], shells: list[RemoteShell], workers: list
+    ) -> None:
+        """Tar once, broadcast to every worker in parallel
+        (reference: applyCreates/uploadArchive; fan-out per SURVEY §2.2)."""
+        for batch in _batch_entries(entries):
+            tar_bytes = build_tar(self.opts.local_path, batch)
+            if not tar_bytes:
+                continue
+
+            def send(i: int) -> None:
+                self._upload_raw(shells[i], workers[i], tar_bytes)
+
+            futures = [self._pool.submit(send, i) for i in range(len(shells))]
+            errors = []
+            for f in futures:
+                try:
+                    f.result()
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+            if errors:
+                raise SyncError(f"upload failed on {len(errors)} worker(s): {errors[0]}")
+            for info in batch:
+                self.index.set(info)
+            self.stats["uploaded"] += len(batch)
+            if self.opts.verbose:
+                for info in batch:
+                    self.log.debug("[sync] upload %s", info.name)
+        self.log.info("[sync] Uploaded %d change(s) to %d worker(s)", len(entries), len(shells))
+
+    def _upload_to(self, shell: RemoteShell, worker, entries: list[FileInformation]) -> None:
+        for batch in _batch_entries(entries):
+            tar_bytes = build_tar(self.opts.local_path, batch)
+            if tar_bytes:
+                self._upload_raw(shell, worker, tar_bytes)
+
+    def _upload_raw(self, shell: RemoteShell, worker, tar_bytes: bytes) -> None:
+        shell.upload_tar(self._remote_dir(worker), tar_bytes, limiter=self._up_limiter)
+
+    def _apply_removes(self, relpaths: list[str]) -> None:
+        def send(i: int) -> None:
+            self._shells[i].remove_paths(self._remote_dir(self.workers[i]), relpaths)
+
+        futures = [self._pool.submit(send, i) for i in range(len(self._shells))]
+        for f in futures:
+            f.result()
+        for rel in relpaths:
+            self.index.remove(rel)
+        self.stats["removed_remote"] += len(relpaths)
+        self.log.info("[sync] Removed %d path(s) on %d worker(s)", len(relpaths), len(self._shells))
+
+    # -- downstream --------------------------------------------------------
+    def _downstream_loop(self) -> None:
+        """Poll worker 0; act only after `stable_polls` identical snapshots
+        (reference: downstream.go mainLoop 105-134)."""
+        assert self._down_shell is not None
+        previous: Optional[dict[str, FileInformation]] = None
+        stable = 0
+        applied_version: Optional[frozenset] = None
+        try:
+            while not self._stopped.is_set():
+                time.sleep(self.opts.downstream_interval)
+                if self._stopped.is_set():
+                    return
+                snap = self._down_shell.snapshot(self._remote_dir(self.workers[0]))
+                snap = {
+                    rel: info
+                    for rel, info in snap.items()
+                    if not self.exclude.matches(rel, info.is_directory)
+                }
+                with self._last_remote_lock:
+                    self._last_remote = snap
+                version = frozenset(
+                    (rel, info.size, info.mtime) for rel, info in snap.items()
+                )
+                if previous is not None and version == frozenset(
+                    (rel, i.size, i.mtime) for rel, i in previous.items()
+                ):
+                    stable += 1
+                else:
+                    stable = 1
+                previous = snap
+                if stable >= self.opts.stable_polls and version != applied_version:
+                    self._apply_downstream(snap)
+                    applied_version = version
+        except BaseException as e:  # noqa: BLE001
+            if not self._stopped.is_set():
+                self.stop(e)
+
+    def _apply_downstream(self, snap: dict[str, FileInformation]) -> None:
+        downloads: list[str] = []
+        local_removes: list[str] = []
+        for rel, ri in snap.items():
+            if self.download_exclude.matches(rel, ri.is_directory):
+                continue
+            if ri.is_directory:
+                if rel not in self.index:
+                    os.makedirs(
+                        os.path.join(self.opts.local_path, rel.replace("/", os.sep)),
+                        exist_ok=True,
+                    )
+                    self.index.set(ri)
+                continue
+            idx = self.index.get(rel)
+            if idx is None or not ri.same_as(idx):
+                li = local_file_information(self.opts.local_path, rel)
+                if li is not None and li.mtime > ri.mtime:
+                    continue  # local is newer — upstream will push it
+                if li is not None and idx is not None and not li.same_as(idx):
+                    continue  # local changed since last sync — upstream wins
+                downloads.append(rel)
+        for rel, idx in self.index.snapshot().items():
+            if rel in snap:
+                continue
+            if self.download_exclude.matches(rel, idx.is_directory):
+                continue
+            # Deletion triple-check (reference: evaluater.go:139): the entry
+            # is indexed, gone remotely (2 stable polls), and the local file
+            # still matches the index exactly.
+            li = local_file_information(self.opts.local_path, rel)
+            if li is None:
+                self.index.remove(rel)
+                continue
+            if idx.is_directory and li.is_directory:
+                local_removes.append(rel)
+            elif not idx.is_directory and not li.is_directory and li.same_as(idx):
+                local_removes.append(rel)
+        if downloads:
+            self._apply_downloads(downloads)
+        if local_removes:
+            self._apply_local_removes(local_removes)
+
+    def _apply_downloads(self, relpaths: list[str]) -> None:
+        assert self._down_shell is not None
+        remote_dir = self._remote_dir(self.workers[0])
+        count = 0
+        for batch in RemoteShell.iter_download_batches(relpaths):
+            tar_bytes = self._down_shell.download_tar(
+                remote_dir, batch, limiter=self._down_limiter
+            )
+            if not tar_bytes:
+                continue
+            applied = extract_tar(tar_bytes, self.opts.local_path, self.index)
+            count += len(applied)
+            if self.opts.verbose:
+                for info in applied:
+                    self.log.debug("[sync] download %s", info.name)
+        self.stats["downloaded"] += count
+        self.log.info("[sync] Downloaded %d change(s)", count)
+        # Mirror downloads to non-authoritative workers so the slice stays
+        # uniform (worker 0 is the source of truth).
+        if len(self.workers) > 1:
+            entries = [
+                info
+                for rel in relpaths
+                if (info := local_file_information(self.opts.local_path, rel))
+                is not None
+            ]
+
+            def send(i: int) -> None:
+                self._upload_to(self._shells[i], self.workers[i], entries)
+
+            futures = [
+                self._pool.submit(send, i) for i in range(1, len(self.workers))
+            ]
+            for f in futures:
+                f.result()
+
+    def _apply_local_removes(self, relpaths: list[str]) -> None:
+        """Careful local deletion (reference: deleteSafeRecursive,
+        sync/util.go:247 — only delete what the index says we created)."""
+        import shutil
+
+        for rel in sorted(relpaths, key=len, reverse=True):
+            full = os.path.join(self.opts.local_path, rel.replace("/", os.sep))
+            idx = self.index.get(rel)
+            if idx is None:
+                continue
+            try:
+                if idx.is_directory:
+                    # Only remove if every child is also index-tracked (i.e.
+                    # nothing local-only would be lost).
+                    safe = True
+                    for dirpath, dirnames, filenames in os.walk(full):
+                        for name in filenames + list(dirnames):
+                            sub = os.path.relpath(
+                                os.path.join(dirpath, name), self.opts.local_path
+                            ).replace(os.sep, "/")
+                            if sub not in self.index:
+                                safe = False
+                                break
+                        if not safe:
+                            break
+                    if safe:
+                        shutil.rmtree(full, ignore_errors=True)
+                        self.index.remove(rel)
+                        self.stats["removed_local"] += 1
+                else:
+                    li = local_file_information(self.opts.local_path, rel)
+                    if li is not None and li.same_as(idx):
+                        os.unlink(full)
+                        self.index.remove(rel)
+                        self.stats["removed_local"] += 1
+            except OSError:
+                continue
+        self.log.info("[sync] Removed %d local path(s)", len(relpaths))
+
+    # -- one-shot copy (reference: sync/util.go:21 CopyToContainer) ---------
+
+
+def copy_to_container(
+    backend,
+    worker,
+    local_path: str,
+    container_path: str,
+    exclude_paths: Optional[list[str]] = None,
+    container: Optional[str] = None,
+    logger=None,
+) -> int:
+    """One-shot upload of a local tree into a container (used by the kaniko
+    builder for build-context upload; reference: sync/util.go CopyToContainer).
+    Returns the number of entries uploaded."""
+    opts = SyncOptions(
+        local_path=local_path,
+        container_path=container_path,
+        exclude_paths=exclude_paths or [],
+        container=container,
+    )
+    session = SyncSession(backend, [worker], opts, logger)
+    proc = backend.exec_stream(worker, ["sh"], container=container, tty=False)
+    shell = RemoteShell(proc, label="copy")
+    try:
+        entries = list(session._walk_local().values())
+        session._shells = [shell]
+        for batch in _batch_entries(entries):
+            tar_bytes = build_tar(local_path, batch)
+            if tar_bytes:
+                shell.upload_tar(
+                    backend.translate_path(worker, container_path), tar_bytes
+                )
+        return len(entries)
+    finally:
+        shell.close()
+
+
+def _batch_entries(entries: list[FileInformation]):
+    """Split uploads into bounded batches (reference: 1000 files/batch,
+    sync_config.go:20; plus a byte bound so tars stay in memory safely)."""
+    batch: list[FileInformation] = []
+    size = 0
+    for info in entries:
+        batch.append(info)
+        size += info.size
+        if len(batch) >= UPLOAD_BATCH_FILES or size >= UPLOAD_BATCH_BYTES:
+            yield batch
+            batch, size = [], 0
+    if batch:
+        yield batch

@@ -49,17 +49,17 @@ def _ws_pair():
 
     def server():
         conn, _ = lsock.accept()
-        ws.server_handshake(conn)
-        result["server"] = ws.WebSocket(conn, is_client=False)
+        _, rest = ws.server_handshake(conn)
+        result["server"] = ws.WebSocket(conn, is_client=False, prebuffer=rest)
 
     t = threading.Thread(target=server)
     t.start()
     csock = socket.create_connection(("127.0.0.1", port))
-    proto = ws.client_handshake(csock, "127.0.0.1", "/", subprotocols=["v4.channel.k8s.io"])
+    proto, rest = ws.client_handshake(csock, "127.0.0.1", "/", subprotocols=["v4.channel.k8s.io"])
     t.join()
     lsock.close()
     assert proto == "v4.channel.k8s.io"
-    return ws.WebSocket(csock, is_client=True), result["server"]
+    return ws.WebSocket(csock, is_client=True, prebuffer=rest), result["server"]
 
 
 def test_websocket_echo_and_large_frames():
