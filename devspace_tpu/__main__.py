@@ -1,0 +1,8 @@
+"""``python -m devspace_tpu`` entry point (reference: main.go -> cmd.Execute)."""
+
+import sys
+
+from .cli.main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""Cluster diagnosis: "why is my app broken" report + TPU health checks.
+
+Reference: pkg/devspace/analyze/ — waits up to 120s for pods to settle
+(pods.go:19,63-99), then reports abnormal events grouped per object
+(events.go), pod statuses against known-bad sets (pods.go:28-44), container
+restarts within 2h / terminations / last log tail (pods.go:120-270), as a
+bordered text report (analyze.go:74-105). TPU additions per SURVEY §5.8:
+slice-level checks — worker count vs config, missing/duplicate
+TPU_WORKER_ID, mixed slice scheduling.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..config import latest
+from ..kube.client import CRITICAL_STATUS, get_pod_status
+
+SETTLE_TIMEOUT = 120.0  # reference: analyze/pods.go:19
+IGNORE_POD_STATUS = {"Running", "Succeeded", "Completed", "Terminating"}
+
+
+def wait_for_settle(backend, namespace: str, timeout: float = SETTLE_TIMEOUT, interval: float = 2.0) -> list:
+    """Wait until no pod is mid-transition (reference: pods.go:63-99)."""
+    deadline = time.monotonic() + timeout
+    pods = backend.list_pods(namespace)
+    while time.monotonic() < deadline:
+        pods = backend.list_pods(namespace)
+        pending = [
+            p
+            for p in pods
+            if get_pod_status(p) not in IGNORE_POD_STATUS | CRITICAL_STATUS
+        ]
+        if not pending:
+            break
+        time.sleep(interval)
+    return pods
+
+
+def analyze_pods(backend, namespace: str, wait: bool = True) -> list[str]:
+    problems: list[str] = []
+    pods = wait_for_settle(backend, namespace) if wait else backend.list_pods(namespace)
+    for pod in pods:
+        status = get_pod_status(pod)
+        if status in ("Running", "Succeeded", "Completed"):
+            restarts = sum(
+                cs.get("restartCount", 0)
+                for cs in pod.raw.get("status", {}).get("containerStatuses") or []
+            )
+            if restarts > 0:
+                problems.append(
+                    f"Pod {pod.name}: {restarts} container restart(s) — check logs"
+                )
+            continue
+        lines = [f"Pod {pod.name}: status {status}"]
+        for cs in pod.raw.get("status", {}).get("containerStatuses") or []:
+            state = cs.get("state") or {}
+            waiting = state.get("waiting") or {}
+            term = state.get("terminated") or {}
+            if waiting.get("message"):
+                lines.append(f"  container {cs.get('name')}: {waiting['message']}")
+            if term:
+                lines.append(
+                    f"  container {cs.get('name')} terminated: "
+                    f"reason={term.get('reason')} exit={term.get('exitCode')}"
+                )
+        try:
+            tail = list(backend.logs(pod, namespace=namespace, tail=5))
+            if tail:
+                lines.append("  last log lines:")
+                lines.extend(
+                    "    " + ln.decode("utf-8", "replace") for ln in tail[-5:]
+                )
+        except Exception:  # noqa: BLE001 — logs unavailable for broken pods
+            pass
+        problems.append("\n".join(lines))
+    return problems
+
+
+def analyze_events(backend, namespace: str) -> list[str]:
+    problems: list[str] = []
+    by_object: dict[str, list[dict]] = {}
+    try:
+        events = backend.list_events(namespace)
+    except Exception:  # noqa: BLE001
+        return problems
+    for ev in events:
+        if ev.get("type") in (None, "Normal"):
+            continue
+        obj = ev.get("involvedObject", {})
+        key = f"{obj.get('kind', '?')}/{obj.get('name', '?')}"
+        by_object.setdefault(key, []).append(ev)
+    for key, evs in by_object.items():
+        latest_ev = max(evs, key=lambda e: e.get("lastTimestamp") or "")
+        problems.append(
+            f"{key}: {len(evs)} warning event(s); latest: "
+            f"[{latest_ev.get('reason', '?')}] {latest_ev.get('message', '')}"
+        )
+    return problems
+
+
+def analyze_tpu_slice(
+    backend, config: latest.Config, namespace: str
+) -> list[str]:
+    """TPU-specific preflight (SURVEY §5.8: the CLI's ICI-side duty is
+    topology wiring + health checks, never collectives)."""
+    problems: list[str] = []
+    if not config.tpu or not config.deployments:
+        return problems
+    want = config.tpu.workers or 1
+    for d in config.deployments:
+        if not d.name:
+            continue
+        pods = backend.list_pods(
+            d.namespace or namespace, label_selector={"app": d.name}
+        )
+        if not pods:
+            continue
+        running = [p for p in pods if get_pod_status(p) == "Running"]
+        if len(running) != want:
+            problems.append(
+                f"TPU slice {d.name}: {len(running)}/{want} workers Running"
+            )
+        ids = [p.tpu_worker_id for p in running]
+        missing = [i for i in range(want) if i not in ids]
+        if running and missing:
+            problems.append(
+                f"TPU slice {d.name}: missing TPU_WORKER_ID(s) {missing} "
+                f"(got {sorted(i for i in ids if i is not None)})"
+            )
+        dupes = {i for i in ids if i is not None and ids.count(i) > 1}
+        if dupes:
+            problems.append(
+                f"TPU slice {d.name}: duplicate TPU_WORKER_ID(s) {sorted(dupes)}"
+            )
+    return problems
+
+
+def create_report(
+    backend,
+    namespace: str,
+    config: Optional[latest.Config] = None,
+    wait: bool = True,
+) -> str:
+    """Bordered text report (reference: analyze.go:44 CreateReport)."""
+    sections = [
+        ("Pods", analyze_pods(backend, namespace, wait=wait)),
+        ("Events", analyze_events(backend, namespace)),
+    ]
+    if config is not None:
+        sections.append(("TPU slice", analyze_tpu_slice(backend, config, namespace)))
+    problems_total = sum(len(p) for _, p in sections)
+    width = 72
+    lines = ["=" * width, f"Analysis of namespace '{namespace}'".center(width), "=" * width]
+    if problems_total == 0:
+        lines.append("No problems found.".center(width))
+    else:
+        for title, problems in sections:
+            if not problems:
+                continue
+            lines.append(f"--- {title} " + "-" * (width - len(title) - 5))
+            for p in problems:
+                lines.append(p)
+    lines.append("=" * width)
+    return "\n".join(lines)

@@ -1,0 +1,654 @@
+"""devspace-tpu CLI — the command tree.
+
+Reference: cmd/ (cobra root + subcommands, SURVEY §2.1): dev, deploy, init,
+enter, logs, analyze, purge, reset, status {deployments,sync}, add/remove
+{sync,port,selector,deployment,image}, list {...}, use {config,context,
+namespace}, update config, upgrade. Run as ``python -m devspace_tpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+import yaml
+
+from .. import __version__
+from ..config import latest
+from ..config.loader import ConfigLoader, config_exists, find_root
+from ..config.structs import to_dict
+from ..utils import log as logutil
+from ..utils import stdinutil
+from ..utils.dockerfile import get_ports
+from ..utils.ignoreutil import get_ignore_rules
+from .context import CLIError, Context
+from .pipeline import DevLoop, build_and_deploy
+
+
+def _ask(question: str, default: str = "", pattern: Optional[str] = None) -> str:
+    return stdinutil.ask(
+        stdinutil.Question(question=question, default=default, validation_pattern=pattern)
+    )
+
+
+# -- init -------------------------------------------------------------------
+def cmd_init(args) -> int:
+    """Reference: cmd/init.go — scaffold Dockerfile + chart + config."""
+    from ..generator.generator import create_chart, create_dockerfile, detect_language
+
+    log = logutil.get_logger()
+    root = os.getcwd()
+    if config_exists(root) and not args.reconfigure:
+        log.warn("config already exists — use --reconfigure to overwrite")
+        return 1
+    name = _ask("Project name", os.path.basename(root) or "app", r"[a-z0-9-]+")
+    language = args.language or detect_language(root)
+    language = _ask("Project language (jax/python/node/go)", language)
+    dockerfile = create_dockerfile(root, language, log)
+    create_chart(root, language, log)
+    image = _ask("Container image to build (e.g. gcr.io/proj/app)", f"registry.local/{name}")
+
+    cfg = latest.new()
+    cfg.images = {
+        "default": latest.ImageConfig(
+            image=image, dockerfile="Dockerfile", context=".", create_pull_secret=True
+        )
+    }
+    cfg.deployments = [
+        latest.DeploymentConfig(name=name, chart=latest.ChartConfig(path="./chart"))
+    ]
+    if language == "jax":
+        accelerator = _ask("TPU accelerator type", "v5litepod-8")
+        workers = int(_ask("TPU worker hosts in the slice", "2", r"[0-9]+"))
+        topology = _ask("TPU topology", "2x4")
+        cfg.tpu = latest.TPUConfig(
+            accelerator=accelerator, workers=workers, topology=topology,
+            chips_per_worker=4,
+        )
+    ports = get_ports(dockerfile) or ([8888] if language == "jax" else [8080])
+    excludes = ["chart/", ".devspace/", ".git/"] + get_ignore_rules(
+        os.path.join(root, ".dockerignore")
+    )
+    cfg.dev = latest.DevConfig(
+        selectors=[
+            latest.SelectorConfig(name="default", label_selector={"app": name})
+        ],
+        ports=[
+            latest.PortForwardingConfig(
+                selector="default",
+                port_mappings=[
+                    latest.PortMapping(local_port=p, remote_port=p) for p in ports
+                ],
+            )
+        ],
+        sync=[
+            latest.SyncConfig(
+                selector="default",
+                local_sub_path=".",
+                container_path="/app",
+                exclude_paths=excludes,
+                fan_out="all",
+            )
+        ],
+        terminal=latest.TerminalConfig(selector="default"),
+        auto_reload=latest.AutoReloadConfig(paths=["Dockerfile", "chart/**"]),
+        override_images=[
+            latest.ImageOverrideConfig(
+                name="default", entrypoint=["sleep", "999999999"]
+            )
+        ],
+    )
+    loader = ConfigLoader(root, log)
+    loader.save(cfg)
+    log.done("[init] project ready — next: 'devspace-tpu dev'")
+    return 0
+
+
+# -- pipeline commands ------------------------------------------------------
+def cmd_deploy(args) -> int:
+    """Reference: cmd/deploy.go — CI-style build+deploy, no dev overrides."""
+    ctx = Context(args)
+    build_and_deploy(
+        ctx,
+        dev_mode=False,
+        force_build=args.force_build,
+        force_deploy=args.force_deploy,
+    )
+    ctx.log.done("[deploy] done — run 'devspace-tpu analyze' if pods misbehave")
+    return 0
+
+
+def cmd_dev(args) -> int:
+    """Reference: cmd/dev.go — THE dev loop."""
+    ctx = Context(args)
+    loop = DevLoop(ctx, args)
+    try:
+        return loop.run()
+    except KeyboardInterrupt:
+        ctx.log.info("[dev] interrupted — tearing down services")
+        loop.stop()
+        loop.stop_services()
+        return 0
+
+
+def cmd_purge(args) -> int:
+    """Reference: cmd/purge.go — delete deployments in reverse order."""
+    from ..deploy.manifests import purge_all
+
+    ctx = Context(args)
+    purge_all(ctx.backend, ctx.config, ctx.namespace, base_dir=ctx.root, logger=ctx.log)
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """Reference: cmd/reset.go — remove everything devspace created."""
+    from ..deploy.manifests import purge_all
+
+    ctx = Context(args)
+    try:
+        purge_all(ctx.backend, ctx.config, ctx.namespace, base_dir=ctx.root, logger=ctx.log)
+    except Exception as e:  # noqa: BLE001 — cluster may be gone already
+        ctx.log.warn("[reset] purge failed: %s", e)
+    import shutil
+
+    devspace_dir = os.path.join(ctx.root, ".devspace")
+    if os.path.isdir(devspace_dir):
+        shutil.rmtree(devspace_dir)
+        ctx.log.done("[reset] removed .devspace/")
+    if args.all:
+        for extra in ("chart", "Dockerfile"):
+            path = os.path.join(ctx.root, extra)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            elif os.path.isfile(path):
+                os.unlink(path)
+        ctx.log.done("[reset] removed generated chart/ and Dockerfile")
+    return 0
+
+
+# -- session commands -------------------------------------------------------
+def cmd_enter(args) -> int:
+    """Reference: cmd/enter.go — shell into a slice worker."""
+    from ..services.sessions import start_terminal
+
+    ctx = Context(args)
+    command = args.command if args.command else None
+    return start_terminal(
+        ctx.backend, ctx.config, command=command, worker_index=args.worker, logger=ctx.log
+    )
+
+
+def cmd_logs(args) -> int:
+    """Reference: cmd/logs.go — now worker-prefix-muxed across the slice."""
+    from ..services.selectors import resolve_workers
+    from ..services.sessions import LogMux
+
+    ctx = Context(args)
+    workers, ns, container = resolve_workers(
+        ctx.backend, ctx.config, selector_name=args.selector, timeout=60.0
+    )
+    if args.worker is not None:
+        workers = [workers[min(args.worker, len(workers) - 1)]]
+    mux = LogMux(ctx.backend, workers, ns, container=container, tail=args.lines)
+    mux.run_once()
+    if args.follow:
+        mux.follow()
+        try:
+            import time
+
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            mux.stop()
+    return 0
+
+
+def cmd_analyze(args) -> int:
+    """Reference: cmd/analyze.go."""
+    from ..analyze.analyze import create_report
+
+    ctx = Context(args)
+    report = create_report(
+        ctx.backend, ctx.namespace, config=ctx.config, wait=not args.no_wait
+    )
+    print(report)
+    return 0
+
+
+# -- status -----------------------------------------------------------------
+def cmd_status(args) -> int:
+    """Reference: cmd/status/{deployments,sync}.go."""
+    ctx = Context(args)
+    log = ctx.log
+    if args.what == "deployments":
+        from ..deploy.manifests import create_deployer
+
+        rows = []
+        for d in ctx.config.deployments or []:
+            deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, log)
+            for s in deployer.status():
+                rows.append(
+                    [
+                        d.name,
+                        s["kind"],
+                        s["name"],
+                        s["namespace"],
+                        "Deployed" if s["found"] else "Missing",
+                    ]
+                )
+        log.print_table(
+            ["DEPLOYMENT", "KIND", "NAME", "NAMESPACE", "STATUS"], rows
+        )
+    else:  # sync — scrape the sync log (reference: status/sync.go regexes)
+        import json as _json
+
+        sync_log = os.path.join(ctx.root, ".devspace", "logs", "sync.log")
+        entries = []
+        try:
+            with open(sync_log, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        entries.append(_json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            log.warn("no sync log found at %s", sync_log)
+            return 1
+        uploads = sum(1 for e in entries if "Uploaded" in e.get("msg", ""))
+        downloads = sum(1 for e in entries if "Downloaded" in e.get("msg", ""))
+        started = [e for e in entries if "starting" in e.get("msg", "")]
+        errors = [e for e in entries if e.get("level") in ("error", "fatal")]
+        status = "Error" if errors else ("Active" if started else "Stopped")
+        log.print_table(
+            ["STATUS", "SESSIONS", "UPLOAD BATCHES", "DOWNLOAD BATCHES", "ERRORS"],
+            [[status, str(len(started)), str(uploads), str(downloads), str(len(errors))]],
+        )
+        if errors:
+            log.error("last error: %s", errors[-1].get("msg", ""))
+    return 0
+
+
+# -- config mutation (add/remove) ------------------------------------------
+def _load_for_edit(args) -> tuple[Context, latest.Config]:
+    ctx = Context(args)
+    return ctx, ctx.config
+
+
+def cmd_add(args) -> int:
+    """Reference: cmd/add/*.go -> pkg/devspace/configure."""
+    ctx, cfg = _load_for_edit(args)
+    if cfg.dev is None:
+        cfg.dev = latest.DevConfig()
+    if args.kind == "sync":
+        cfg.dev.sync = (cfg.dev.sync or []) + [
+            latest.SyncConfig(
+                selector=args.selector,
+                local_sub_path=args.local,
+                container_path=args.container,
+                exclude_paths=args.exclude.split(",") if args.exclude else None,
+            )
+        ]
+    elif args.kind == "port":
+        cfg.dev.ports = (cfg.dev.ports or []) + [
+            latest.PortForwardingConfig(
+                selector=args.selector,
+                port_mappings=[
+                    latest.PortMapping(
+                        local_port=args.local_port,
+                        remote_port=args.remote_port or args.local_port,
+                    )
+                ],
+            )
+        ]
+    elif args.kind == "selector":
+        labels = dict(kv.split("=", 1) for kv in args.label_selector.split(","))
+        cfg.dev.selectors = (cfg.dev.selectors or []) + [
+            latest.SelectorConfig(name=args.name, label_selector=labels)
+        ]
+    elif args.kind == "deployment":
+        if args.manifests:
+            dep = latest.DeploymentConfig(
+                name=args.name,
+                manifests=latest.ManifestsConfig(paths=args.manifests.split(",")),
+            )
+        else:
+            dep = latest.DeploymentConfig(
+                name=args.name, chart=latest.ChartConfig(path=args.chart or "./chart")
+            )
+        cfg.deployments = (cfg.deployments or []) + [dep]
+    elif args.kind == "image":
+        cfg.images = cfg.images or {}
+        cfg.images[args.name] = latest.ImageConfig(
+            image=args.image, dockerfile=args.dockerfile, context=args.context
+        )
+    ctx.loader.validate(cfg)
+    ctx.loader.save(cfg)
+    ctx.log.done("[add] %s added", args.kind)
+    return 0
+
+
+def cmd_remove(args) -> int:
+    """Reference: cmd/remove/*.go."""
+    ctx, cfg = _load_for_edit(args)
+    removed = False
+    if args.kind == "sync" and cfg.dev and cfg.dev.sync:
+        before = len(cfg.dev.sync)
+        cfg.dev.sync = [
+            s
+            for s in cfg.dev.sync
+            if not (args.all or s.container_path == args.container)
+        ] or None
+        removed = before != len(cfg.dev.sync or [])
+    elif args.kind == "port" and cfg.dev and cfg.dev.ports:
+        before = len(cfg.dev.ports)
+        cfg.dev.ports = [
+            p
+            for p in cfg.dev.ports
+            if not (
+                args.all
+                or any(
+                    pm.local_port == args.local_port for pm in p.port_mappings or []
+                )
+            )
+        ] or None
+        removed = before != len(cfg.dev.ports or [])
+    elif args.kind == "selector" and cfg.dev and cfg.dev.selectors:
+        before = len(cfg.dev.selectors)
+        cfg.dev.selectors = [
+            s for s in cfg.dev.selectors if not (args.all or s.name == args.name)
+        ] or None
+        removed = before != len(cfg.dev.selectors or [])
+    elif args.kind == "deployment" and cfg.deployments:
+        before = len(cfg.deployments)
+        cfg.deployments = [
+            d for d in cfg.deployments if not (args.all or d.name == args.name)
+        ] or None
+        removed = before != len(cfg.deployments or [])
+    elif args.kind == "image" and cfg.images:
+        removed = cfg.images.pop(args.name, None) is not None
+        cfg.images = cfg.images or None
+    ctx.loader.save(cfg)
+    ctx.log.done("[remove] %s %s", args.kind, "removed" if removed else "not found")
+    return 0 if removed else 1
+
+
+# -- list -------------------------------------------------------------------
+def cmd_list(args) -> int:
+    """Reference: cmd/list/*.go."""
+    ctx = Context(args)
+    cfg = ctx.config
+    log = ctx.log
+    what = args.what
+    if what == "deployments":
+        log.print_table(
+            ["NAME", "TYPE", "NAMESPACE"],
+            [
+                [
+                    d.name,
+                    "chart" if d.chart else "manifests",
+                    d.namespace or ctx.namespace,
+                ]
+                for d in cfg.deployments or []
+            ],
+        )
+    elif what == "images":
+        log.print_table(
+            ["NAME", "IMAGE", "DOCKERFILE"],
+            [
+                [name, i.image, i.dockerfile or "Dockerfile"]
+                for name, i in (cfg.images or {}).items()
+            ],
+        )
+    elif what == "ports":
+        rows = []
+        for p in (cfg.dev.ports if cfg.dev else None) or []:
+            for pm in p.port_mappings or []:
+                rows.append(
+                    [p.selector or "-", str(pm.local_port), str(pm.remote_port), p.workers or "worker0"]
+                )
+        log.print_table(["SELECTOR", "LOCAL", "REMOTE", "WORKERS"], rows)
+    elif what == "sync":
+        log.print_table(
+            ["SELECTOR", "LOCAL", "CONTAINER", "FAN-OUT"],
+            [
+                [s.selector or "-", s.local_sub_path or ".", s.container_path, s.fan_out or "all"]
+                for s in (cfg.dev.sync if cfg.dev else None) or []
+            ],
+        )
+    elif what == "selectors":
+        log.print_table(
+            ["NAME", "NAMESPACE", "LABELS"],
+            [
+                [
+                    s.name,
+                    s.namespace or ctx.namespace,
+                    ",".join(f"{k}={v}" for k, v in (s.label_selector or {}).items()),
+                ]
+                for s in (cfg.dev.selectors if cfg.dev else None) or []
+            ],
+        )
+    elif what == "vars":
+        cache = ctx.loader.generated.get_active()
+        log.print_table(
+            ["NAME", "VALUE"], [[k, v] for k, v in cache.vars.items()]
+        )
+    elif what == "configs":
+        configs_path = os.path.join(ctx.root, ".devspace", "configs.yaml")
+        if os.path.isfile(configs_path):
+            with open(configs_path, "r", encoding="utf-8") as fh:
+                names = list((yaml.safe_load(fh) or {}).keys())
+        else:
+            names = ["default"]
+        active = ctx.loader.generated.active_config
+        log.print_table(
+            ["NAME", "ACTIVE"], [[n, "*" if n == active else ""] for n in names]
+        )
+    return 0
+
+
+# -- use --------------------------------------------------------------------
+def cmd_use(args) -> int:
+    """Reference: cmd/use/*.go."""
+    log = logutil.get_logger()
+    if args.kind == "config":
+        ctx = Context(args, require_config=False)
+        ctx.loader.generated.active_config = args.name
+        ctx.loader.generated.save()
+        log.done("[use] active config: %s", args.name)
+    elif args.kind == "context":
+        from ..kube.kubeconfig import KubeConfig
+
+        kc = KubeConfig.load()
+        if args.name not in kc.contexts:
+            log.error("unknown kube context '%s'", args.name)
+            return 1
+        kc.current_context = args.name
+        kc.save()
+        log.done("[use] kube context: %s", args.name)
+    elif args.kind == "namespace":
+        ctx = Context(args)
+        cfg = ctx.config
+        if cfg.cluster is None:
+            cfg.cluster = latest.Cluster()
+        cfg.cluster.namespace = args.name
+        ctx.loader.save(cfg)
+        log.done("[use] namespace: %s", args.name)
+    return 0
+
+
+# -- update / upgrade -------------------------------------------------------
+def cmd_update(args) -> int:
+    """Reference: cmd/update/config.go — rewrite config at latest schema."""
+    ctx = Context(args)
+    ctx.loader.save(ctx.config)
+    ctx.log.done("[update] config rewritten at schema %s", latest.VERSION)
+    return 0
+
+
+def cmd_upgrade(args) -> int:
+    """Reference: cmd/upgrade.go — self-update via GitHub releases. This
+    build is distributed as a repo checkout; upgrading means git pull."""
+    logutil.get_logger().info(
+        "devspace-tpu %s — upgrade via 'git pull' in the framework checkout",
+        __version__,
+    )
+    return 0
+
+
+def cmd_print_config(args) -> int:
+    ctx = Context(args)
+    print(yaml.safe_dump(to_dict(ctx.config), sort_keys=False))
+    return 0
+
+
+# -- parser -----------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="devspace-tpu",
+        description="TPU-native developer loop: init, deploy and live-dev "
+        "JAX workloads on (GKE) TPU slices.",
+    )
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("--namespace", "-n", help="override namespace")
+    p.add_argument("--kube-context", help="kubeconfig context to use")
+    p.add_argument("--config", help="named config from configs.yaml")
+    p.add_argument("--debug", action="store_true", help="verbose logging")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="scaffold Dockerfile, chart and config")
+    sp.add_argument("--language", choices=["jax", "python", "node", "go"])
+    sp.add_argument("--reconfigure", action="store_true")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("dev", help="build, deploy and start the live dev session")
+    sp.add_argument("--force-build", "-b", action="store_true")
+    sp.add_argument("--force-deploy", "-d", action="store_true")
+    sp.add_argument("--no-sync", action="store_true")
+    sp.add_argument("--no-portforwarding", action="store_true")
+    sp.add_argument("--no-terminal", action="store_true")
+    sp.add_argument("--verbose-sync", action="store_true")
+    sp.set_defaults(fn=cmd_dev)
+
+    sp = sub.add_parser("deploy", help="build and deploy (CI mode)")
+    sp.add_argument("--force-build", "-b", action="store_true")
+    sp.add_argument("--force-deploy", "-d", action="store_true")
+    sp.set_defaults(fn=cmd_deploy)
+
+    sp = sub.add_parser("enter", help="open a shell in a slice worker")
+    sp.add_argument("--worker", "-w", type=int, default=0, help="worker index")
+    sp.add_argument("command", nargs="*", help="command to run instead of a shell")
+    sp.set_defaults(fn=cmd_enter)
+
+    sp = sub.add_parser("logs", help="print worker-prefixed logs")
+    sp.add_argument("--selector", "-s")
+    sp.add_argument("--lines", "-l", type=int, default=100)
+    sp.add_argument("--follow", "-f", action="store_true")
+    sp.add_argument("--worker", "-w", type=int, help="only this worker")
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("analyze", help="diagnose problems in the namespace")
+    sp.add_argument("--no-wait", action="store_true")
+    sp.set_defaults(fn=cmd_analyze)
+
+    sp = sub.add_parser("purge", help="delete all deployments")
+    sp.set_defaults(fn=cmd_purge)
+
+    sp = sub.add_parser("reset", help="purge and remove local devspace state")
+    sp.add_argument("--all", action="store_true", help="also remove chart/ and Dockerfile")
+    sp.set_defaults(fn=cmd_reset)
+
+    sp = sub.add_parser("status", help="deployment / sync status")
+    sp.add_argument("what", choices=["deployments", "sync"])
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("add", help="add config entries")
+    add_sub = sp.add_subparsers(dest="kind", required=True)
+    q = add_sub.add_parser("sync")
+    q.add_argument("--selector", default="default")
+    q.add_argument("--local", default=".")
+    q.add_argument("--container", required=True)
+    q.add_argument("--exclude")
+    q = add_sub.add_parser("port")
+    q.add_argument("--selector", default="default")
+    q.add_argument("local_port", type=int)
+    q.add_argument("remote_port", type=int, nargs="?")
+    q = add_sub.add_parser("selector")
+    q.add_argument("name")
+    q.add_argument("--label-selector", required=True, help="k=v,k2=v2")
+    q = add_sub.add_parser("deployment")
+    q.add_argument("name")
+    q.add_argument("--chart")
+    q.add_argument("--manifests")
+    q = add_sub.add_parser("image")
+    q.add_argument("name")
+    q.add_argument("--image", required=True)
+    q.add_argument("--dockerfile", default="Dockerfile")
+    q.add_argument("--context", default=".")
+    sp.set_defaults(fn=cmd_add)
+
+    sp = sub.add_parser("remove", help="remove config entries")
+    rm_sub = sp.add_subparsers(dest="kind", required=True)
+    q = rm_sub.add_parser("sync")
+    q.add_argument("--container")
+    q.add_argument("--all", action="store_true")
+    q = rm_sub.add_parser("port")
+    q.add_argument("local_port", type=int, nargs="?")
+    q.add_argument("--all", action="store_true")
+    q = rm_sub.add_parser("selector")
+    q.add_argument("name", nargs="?")
+    q.add_argument("--all", action="store_true")
+    q = rm_sub.add_parser("deployment")
+    q.add_argument("name", nargs="?")
+    q.add_argument("--all", action="store_true")
+    q = rm_sub.add_parser("image")
+    q.add_argument("name")
+    sp.set_defaults(fn=cmd_remove)
+
+    sp = sub.add_parser("list", help="list config entries")
+    sp.add_argument(
+        "what",
+        choices=["deployments", "images", "ports", "sync", "selectors", "vars", "configs"],
+    )
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("use", help="select config/context/namespace")
+    use_sub = sp.add_subparsers(dest="kind", required=True)
+    for kind in ("config", "context", "namespace"):
+        q = use_sub.add_parser(kind)
+        q.add_argument("name")
+    sp.set_defaults(fn=cmd_use)
+
+    sp = sub.add_parser("update", help="rewrite config at the latest schema")
+    sp.set_defaults(fn=cmd_update)
+
+    sp = sub.add_parser("upgrade", help="show upgrade instructions")
+    sp.set_defaults(fn=cmd_upgrade)
+
+    sp = sub.add_parser("print", help="print the resolved config")
+    sp.set_defaults(fn=cmd_print_config)
+
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.debug:
+        logutil.get_logger().level = "debug"
+    root = find_root(os.getcwd())
+    if root is not None:
+        # Mirror everything into .devspace/logs/default.log (reference:
+        # log.StartFileLogging at the top of every command, cmd/dev.go:139).
+        logutil.start_file_logging(os.path.join(root, ".devspace"))
+    try:
+        return args.fn(args)
+    except CLIError as e:
+        logutil.get_logger().error(str(e))
+        return 1
+    except logutil.FatalError:
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

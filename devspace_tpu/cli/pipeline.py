@@ -1,0 +1,206 @@
+"""The shared build->deploy pipeline and the dev loop.
+
+Reference: cmd/dev.go (buildAndDeploy 185, startServices 243, reload on
+watcher change 230-234) and cmd/deploy.go (CI-style, no dev overrides).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..builder.images import build_all
+from ..builder.registry import init_registries
+from ..config import latest
+from ..deploy.manifests import deploy_all
+from ..services import sessions as svc
+from ..services.watch import GlobWatcher
+from ..utils import log as logutil
+from .context import Context
+
+
+def inject_default_image(config: latest.Config, image_tags: dict[str, str]) -> None:
+    """Charts default to ``values.image``; point it at the freshly built
+    image when the user didn't set one explicitly (the reference injects
+    a .Values.images map the same way, deploy/helm/deploy.go:154-161)."""
+    if not image_tags:
+        return
+    default_ref = image_tags.get("default") or next(iter(image_tags.values()))
+    for d in config.deployments or []:
+        if d.chart is not None:
+            values = dict(d.chart.values or {})
+            values.setdefault("image", default_ref)
+            d.chart.values = values
+
+
+def build_and_deploy(
+    ctx: Context,
+    dev_mode: bool,
+    force_build: bool = False,
+    force_deploy: bool = False,
+    logger: Optional[logutil.Logger] = None,
+) -> dict[str, str]:
+    """Reference: cmd/dev.go buildAndDeploy / cmd/deploy.go Run."""
+    log = logger or ctx.log
+    config = ctx.config
+    backend = ctx.backend
+    backend.ensure_namespace(ctx.namespace)
+    pull_secrets = init_registries(backend, config, ctx.namespace, log)
+    cache = ctx.loader.generated.get_cache(dev_mode)
+    image_tags = build_all(
+        config,
+        cache,
+        backend=backend,
+        dev_mode=dev_mode,
+        force=force_build,
+        base_dir=ctx.root,
+        logger=log,
+    )
+    ctx.save_generated()
+    inject_default_image(config, image_tags)
+    deploy_all(
+        backend,
+        config,
+        ctx.namespace,
+        image_tags=image_tags,
+        pull_secrets=pull_secrets,
+        force=force_deploy,
+        cache=cache,
+        base_dir=ctx.root,
+        logger=log,
+    )
+    ctx.save_generated()
+    return image_tags
+
+
+class DevLoop:
+    """The live dev session: services + auto-reload + interaction
+    (reference: cmd/dev.go startServices + reload loop)."""
+
+    def __init__(self, ctx: Context, args):
+        self.ctx = ctx
+        self.args = args
+        self.log = ctx.log
+        self.sync_sessions: list = []
+        self.forwarders: list = []
+        self.watcher: Optional[GlobWatcher] = None
+        self.logmux: Optional[svc.LogMux] = None
+        self.reload_requested = threading.Event()
+        self.stop_requested = threading.Event()
+        self.services_ready = threading.Event()
+
+    # -- services ----------------------------------------------------------
+    def start_services(self) -> None:
+        config = self.ctx.config
+        backend = self.ctx.backend
+        if not getattr(self.args, "no_portforwarding", False):
+            self.forwarders = svc.start_port_forwarding(backend, config, self.log)
+        if not getattr(self.args, "no_sync", False):
+            self.sync_sessions = svc.start_sync(
+                backend,
+                config,
+                base_dir=self.ctx.root,
+                logger=self.log,
+                verbose=getattr(self.args, "verbose_sync", False),
+            )
+        auto_reload = (config.dev.auto_reload if config.dev else None)
+        if auto_reload and not auto_reload.disabled and auto_reload.paths:
+            self.watcher = GlobWatcher(
+                auto_reload.paths,
+                callback=lambda changed: self._on_reload(changed),
+                base_dir=self.ctx.root,
+            )
+            self.watcher.start()
+        self.services_ready.set()
+
+    def _on_reload(self, changed: list[str]) -> None:
+        self.log.info("[dev] change in %s — redeploying", ", ".join(changed[:3]))
+        self.reload_requested.set()
+
+    def stop_services(self) -> None:
+        self.services_ready.clear()
+        for session in self.sync_sessions:
+            session.stop()
+        for fw in self.forwarders:
+            fw.stop()
+        if self.watcher:
+            self.watcher.stop()
+        if self.logmux:
+            self.logmux.stop()
+        self.sync_sessions, self.forwarders, self.watcher = [], [], None
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> int:
+        """Build, deploy, serve; rebuild on reload; exit on interrupt
+        or terminal exit."""
+        import sys
+
+        first = True
+        while not self.stop_requested.is_set():
+            build_and_deploy(
+                self.ctx,
+                dev_mode=True,
+                force_build=getattr(self.args, "force_build", False) and first,
+                force_deploy=(
+                    getattr(self.args, "force_deploy", False) and first
+                )
+                or not first,
+            )
+            self.start_services()
+            self.reload_requested.clear()
+            rc = self._interact()
+            if rc is not None:
+                self.stop_services()
+                return rc
+            # reload: teardown and loop again
+            self.stop_services()
+            first = False
+        return 0
+
+    def _interact(self) -> Optional[int]:
+        """Block until reload (returns None), stop, or terminal exit
+        (returns exit code)."""
+        import sys
+
+        config = self.ctx.config
+        terminal_conf = config.dev.terminal if config.dev else None
+        want_terminal = (
+            not getattr(self.args, "no_terminal", False)
+            and terminal_conf is not None
+            and not terminal_conf.disabled
+            and sys.stdin.isatty()
+        )
+        if want_terminal:
+            rc = svc.start_terminal(self.ctx.backend, config, logger=self.log)
+            if self.reload_requested.is_set():
+                return None
+            return rc
+        # Non-interactive: worker-prefixed log mux until reload/stop.
+        try:
+            from ..services.selectors import resolve_workers
+
+            workers, ns, container = resolve_workers(
+                self.ctx.backend, config, timeout=svc.POD_WAIT_ATTACH
+            )
+            self.logmux = svc.LogMux(
+                self.ctx.backend, workers, ns, container=container, logger=self.log
+            )
+            self.logmux.follow()
+        except Exception as e:  # noqa: BLE001 — logs are best-effort here
+            self.log.warn("[dev] log streaming unavailable: %s", e)
+        self.log.done(
+            "[dev] session live — sync + forward running; press Ctrl-C to stop"
+        )
+        while not self.stop_requested.is_set():
+            if self.reload_requested.is_set():
+                return None
+            fatal = [s for s in self.sync_sessions if s.error is not None]
+            if fatal:
+                self.log.error("[dev] sync failed: %s", fatal[0].error)
+                return 1
+            time.sleep(0.2)
+        return 0
+
+    def stop(self) -> None:
+        self.stop_requested.set()

@@ -1,0 +1,107 @@
+"""Project scaffolding: language detection, Dockerfile and chart generation.
+
+Reference: pkg/devspace/generator/generator.go — clones the template repo,
+detects the project language via enry over source files (GetLanguage,
+generator.go:33/140+), copies the ``_base`` + ``<language>`` chart template
+into the project (CreateChart, 83-108). Ours ships templates in-package
+(no git clone, no network) and adds the JAX/TPU flavor: a project with JAX
+imports gets the TPU Dockerfile and the TPU slice chart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from collections import Counter
+from typing import Optional
+
+from ..utils import log as logutil
+
+TEMPLATES_DIR = os.path.join(os.path.dirname(__file__), "templates")
+
+_EXT_LANG = {
+    ".py": "python",
+    ".js": "node",
+    ".mjs": "node",
+    ".ts": "node",
+    ".go": "go",
+}
+
+_JAX_IMPORT = re.compile(
+    r"^\s*(?:import|from)\s+(?:jax|flax|optax|orbax)\b", re.MULTILINE
+)
+
+
+def detect_language(project_dir: str, max_files: int = 500) -> str:
+    """Extension-count language detection with a JAX sniff: any Python file
+    importing jax/flax/optax promotes the project to 'jax'."""
+    counts: Counter[str] = Counter()
+    jax_found = False
+    scanned = 0
+    for root, dirs, files in os.walk(project_dir):
+        dirs[:] = [
+            d
+            for d in dirs
+            if d not in (".git", "node_modules", "__pycache__", ".devspace", "venv")
+        ]
+        for name in files:
+            ext = os.path.splitext(name)[1].lower()
+            lang = _EXT_LANG.get(ext)
+            if not lang:
+                continue
+            counts[lang] += 1
+            scanned += 1
+            if lang == "python" and not jax_found:
+                try:
+                    with open(
+                        os.path.join(root, name), "r", encoding="utf-8", errors="ignore"
+                    ) as fh:
+                        if _JAX_IMPORT.search(fh.read(65536)):
+                            jax_found = True
+                except OSError:
+                    pass
+            if scanned >= max_files:
+                break
+        if scanned >= max_files:
+            break
+    if jax_found:
+        return "jax"
+    if not counts:
+        return "python"
+    return counts.most_common(1)[0][0]
+
+
+def create_dockerfile(
+    project_dir: str, language: str, logger: Optional[logutil.Logger] = None
+) -> str:
+    """Copy the language's Dockerfile template unless one exists."""
+    log = logger or logutil.get_logger()
+    dest = os.path.join(project_dir, "Dockerfile")
+    if os.path.exists(dest):
+        log.info("[init] keeping existing Dockerfile")
+        return dest
+    src = os.path.join(TEMPLATES_DIR, "dockerfiles", language, "Dockerfile")
+    if not os.path.isfile(src):
+        src = os.path.join(TEMPLATES_DIR, "dockerfiles", "python", "Dockerfile")
+    shutil.copyfile(src, dest)
+    log.done("[init] created Dockerfile (%s)", language)
+    return dest
+
+
+def create_chart(
+    project_dir: str,
+    language: str,
+    logger: Optional[logutil.Logger] = None,
+) -> str:
+    """Copy the chart template (TPU slice chart for jax, plain chart
+    otherwise) into ``<project>/chart`` (reference: CreateChart)."""
+    log = logger or logutil.get_logger()
+    dest = os.path.join(project_dir, "chart")
+    if os.path.isdir(dest):
+        log.info("[init] keeping existing chart/")
+        return dest
+    flavor = "chart-tpu" if language == "jax" else "chart-cpu"
+    shutil.copytree(os.path.join(TEMPLATES_DIR, flavor), dest)
+    log.done("[init] created chart/ (%s)", flavor)
+    return dest

@@ -132,11 +132,14 @@ def get_pod_status(pod: Pod) -> str:
                 else "Completed"
             )
     if status.get("phase") == "Running":
-        ready = all(
-            (cs or {}).get("ready") for cs in status.get("containerStatuses") or []
-        )
+        statuses = status.get("containerStatuses") or []
+        # No reported container statuses yet => kubelet hasn't confirmed the
+        # containers are up; not Running-ready.
+        ready = bool(statuses) and all((cs or {}).get("ready") for cs in statuses)
         if reason in ("Running", pod.phase) and ready:
             return "Running"
+        if reason in ("Running", pod.phase) and not ready:
+            return "ContainersNotReady"
     return reason
 
 

@@ -28,7 +28,14 @@ from .streams import RemoteProcess, SubprocessRemoteProcess
 class FakeCluster:
     """Mirrors KubeClient's surface against local state."""
 
-    def __init__(self, root: str, logger: Optional[logutil.Logger] = None):
+    is_fake = True  # build pipeline picks the fake builder for fake clusters
+
+    def __init__(
+        self,
+        root: str,
+        logger: Optional[logutil.Logger] = None,
+        persist: bool = False,
+    ):
         self.root = os.path.abspath(root)  # holds per-pod "filesystems"
         self.log = logger or logutil.get_logger()
         self.default_namespace = "default"
@@ -38,6 +45,65 @@ class FakeCluster:
         self.namespaces: set[str] = {"default"}
         self.pod_logs: dict[tuple[str, str], list[bytes]] = {}
         self.pod_ports: dict[tuple[str, str, int], int] = {}  # remote -> local
+        # Persistence lets separate CLI invocations (deploy, then dev) share
+        # one fake cluster, like a real API server would.
+        self._persist = persist
+        if persist:
+            self._load_state()
+
+    # -- persistence -------------------------------------------------------
+    @property
+    def _state_path(self) -> str:
+        return os.path.join(self.root, "cluster-state.json")
+
+    def _load_state(self) -> None:
+        import json
+
+        try:
+            with open(self._state_path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return
+        for entry in data.get("pods", []):
+            self.pods[(entry["ns"], entry["name"])] = entry["manifest"]
+        for entry in data.get("objects", []):
+            self.objects[(entry["kind"], entry["ns"], entry["name"])] = entry[
+                "manifest"
+            ]
+        self.namespaces.update(data.get("namespaces", []))
+
+    def _save_state(self) -> None:
+        if not self._persist:
+            return
+        import json
+        import tempfile
+
+        with self._lock:
+            data = {
+                "pods": [
+                    {"ns": ns, "name": name, "manifest": m}
+                    for (ns, name), m in self.pods.items()
+                ],
+                "objects": [
+                    {"kind": k, "ns": ns, "name": name, "manifest": m}
+                    for (k, ns, name), m in self.objects.items()
+                ],
+                "namespaces": sorted(self.namespaces),
+            }
+            os.makedirs(self.root, exist_ok=True)
+            # Atomic replace: cross-process readers (deploy, then dev) must
+            # never observe a truncated file.
+            fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".state-")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                    json.dump(data, fh)
+                os.replace(tmp, self._state_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
     # -- fixture helpers ---------------------------------------------------
     def pod_dir(self, name: str, namespace: str = "default") -> str:
@@ -88,6 +154,7 @@ class FakeCluster:
         with self._lock:
             self.pods[(namespace, name)] = manifest
         self.pod_dir(name, namespace)
+        self._save_state()
         return Pod(manifest)
 
     def set_pod_phase(self, name: str, phase: str, namespace: str = "default") -> None:
@@ -327,6 +394,7 @@ class FakeCluster:
         with self._lock:
             self.objects[(kind, ns, name)] = copy.deepcopy(manifest)
         self._synthesize_pods(manifest, ns)
+        self._save_state()
         return manifest
 
     def _synthesize_pods(self, manifest: dict, ns: str) -> None:
@@ -375,6 +443,7 @@ class FakeCluster:
             # Cascade: remove synthesized pods.
             for key in [k for k in self.pods if k[0] == ns and k[1].startswith(name + "-")]:
                 del self.pods[key]
+        self._save_state()
         return found is not None
 
     def get_object(
@@ -399,6 +468,7 @@ class FakeCluster:
         ns = namespace or self.default_namespace
         with self._lock:
             self.pods.pop((ns, name), None)
+        self._save_state()
 
     def list_events(
         self, namespace: Optional[str] = None, field_selector: Optional[str] = None

@@ -1,0 +1,77 @@
+"""Selector resolution: which pods does a dev-session service target?
+
+Reference: pkg/devspace/services/{pod_selector.go, attach.go:76
+getSelectorNamespaceLabelSelector} — precedence: explicit selector config >
+inline labelSelector > fallback ``app=<first deployment>`` (the reference
+falls back to ``release=<first helm deployment>``; our charts stamp
+``app: <release>``). The TPU twist (SURVEY §7/L2): a selector resolves to
+the *ordered* worker list of the slice, not one pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import latest
+from ..config.loader import get_default_namespace, get_selector
+
+
+class SelectorError(Exception):
+    pass
+
+
+def resolve_selector(
+    config: latest.Config,
+    selector_name: Optional[str] = None,
+    label_selector: Optional[dict[str, str]] = None,
+    namespace: Optional[str] = None,
+    container: Optional[str] = None,
+) -> tuple[str, dict[str, str], Optional[str]]:
+    """Returns (namespace, label_selector, container_name)."""
+    if selector_name:
+        sel = get_selector(config, selector_name)
+        if sel is None:
+            raise SelectorError(f"unknown selector '{selector_name}'")
+        return (
+            namespace or sel.namespace or get_default_namespace(config),
+            sel.label_selector or {},
+            container or sel.container_name,
+        )
+    if label_selector:
+        return (namespace or get_default_namespace(config), label_selector, container)
+    # Fallback: first deployment's app label (reference: attach.go:120-124).
+    if config.deployments:
+        first = config.deployments[0].name
+        if first:
+            return (
+                namespace
+                or config.deployments[0].namespace
+                or get_default_namespace(config),
+                {"app": first},
+                container,
+            )
+    raise SelectorError(
+        "cannot resolve target pods: no selector, no labelSelector and no "
+        "deployments configured"
+    )
+
+
+def resolve_workers(
+    backend,
+    config: latest.Config,
+    selector_name: Optional[str] = None,
+    label_selector: Optional[dict[str, str]] = None,
+    namespace: Optional[str] = None,
+    container: Optional[str] = None,
+    timeout: float = 120.0,
+) -> tuple[list, str, Optional[str]]:
+    """Resolve the ordered slice worker pods for a service.
+    Returns (workers, namespace, container_name)."""
+    ns, labels, cont = resolve_selector(
+        config, selector_name, label_selector, namespace, container
+    )
+    expected = config.tpu.workers if config.tpu and config.tpu.workers else None
+    workers = backend.slice_workers(
+        labels, namespace=ns, expected=expected, timeout=timeout
+    )
+    return workers, ns, cont

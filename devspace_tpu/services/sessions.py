@@ -1,0 +1,357 @@
+"""Dev-session services: sync, port-forward, logs, attach, terminal.
+
+Reference: pkg/devspace/services/{sync,port_forwarding,logs,attach,
+terminal}.go — each service resolves its target pods, starts, and can be
+stopped independently (SURVEY §7 design stance (c)). All of them fan out
+across the slice workers; logs are multiplexed with a per-worker prefix
+(SURVEY §7 step 7: "aggregated terminal/logs — worker-prefixed log mux").
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+from ..config import latest
+from ..kube.portforward import PortForwarder
+from ..sync.session import SyncOptions, SyncSession
+from ..utils import log as logutil
+from .selectors import resolve_workers
+
+POD_WAIT_SYNC = 120.0  # reference: services/sync.go:70
+POD_WAIT_PORTFORWARD = 120.0  # reference: services/port_forwarding.go:53
+POD_WAIT_TERMINAL = 5.0  # reference: services/terminal.go:65
+POD_WAIT_ATTACH = 60.0  # reference: services/attach.go:26
+PORTFORWARD_READY_TIMEOUT = 20.0  # reference: port_forwarding.go:86-93
+
+
+def start_sync(
+    backend,
+    config: latest.Config,
+    base_dir: str = ".",
+    logger: Optional[logutil.Logger] = None,
+    verbose: bool = False,
+) -> list[SyncSession]:
+    """Start every dev.sync entry (reference: services/sync.go StartSync)."""
+    import os
+
+    log = logger or logutil.get_logger()
+    sessions: list[SyncSession] = []
+    for sc in (config.dev.sync if config.dev else None) or []:
+        workers, ns, container = resolve_workers(
+            backend,
+            config,
+            sc.selector,
+            sc.label_selector,
+            sc.namespace,
+            sc.container_name,
+            timeout=POD_WAIT_SYNC,
+        )
+        local = os.path.join(base_dir, sc.local_sub_path or ".")
+        opts = SyncOptions(
+            local_path=os.path.abspath(local),
+            container_path=sc.container_path or "/app",
+            exclude_paths=sc.exclude_paths or [],
+            download_exclude_paths=sc.download_exclude_paths or [],
+            upload_exclude_paths=sc.upload_exclude_paths or [],
+            upload_limit_kbs=(
+                sc.bandwidth_limits.upload if sc.bandwidth_limits else None
+            ),
+            download_limit_kbs=(
+                sc.bandwidth_limits.download if sc.bandwidth_limits else None
+            ),
+            container=container,
+            fan_out=sc.fan_out or "all",
+            verbose=verbose,
+        )
+        mirror = logutil.get_file_logger("sync", root=os.path.join(base_dir, ".devspace"))
+        session_logger = log
+        log.add_mirror(mirror)
+        session = SyncSession(backend, workers, opts, session_logger)
+        session.start()
+        sessions.append(session)
+        log.done(
+            "[sync] session ready: %s <-> %d worker(s):%s",
+            opts.local_path,
+            len(session.workers),
+            opts.container_path,
+        )
+    return sessions
+
+
+def start_port_forwarding(
+    backend,
+    config: latest.Config,
+    logger: Optional[logutil.Logger] = None,
+) -> list[PortForwarder]:
+    """Start every dev.ports entry (reference:
+    services/port_forwarding.go). TPU twist: ``workers: all`` forwards every
+    worker, offsetting local ports by worker index (worker i reachable at
+    localPort + i)."""
+    log = logger or logutil.get_logger()
+    forwarders: list[PortForwarder] = []
+    for pc in (config.dev.ports if config.dev else None) or []:
+        workers, ns, _ = resolve_workers(
+            backend,
+            config,
+            pc.selector,
+            pc.label_selector,
+            pc.namespace,
+            timeout=POD_WAIT_PORTFORWARD,
+        )
+        targets = workers if (pc.workers == "all") else workers[:1]
+        for i, pod in enumerate(targets):
+            ports = []
+            for pm in pc.port_mappings or []:
+                local = (pm.local_port or pm.remote_port or 0) + i
+                remote = pm.remote_port or pm.local_port or 0
+                ports.append((local, remote))
+            fw = backend.portforward(
+                pod,
+                ports,
+                namespace=ns,
+                bind_address=(pc.port_mappings or [latest.PortMapping()])[0].bind_address
+                or "127.0.0.1",
+            )
+            fw.start()
+            if not fw.ready.wait(PORTFORWARD_READY_TIMEOUT):
+                raise TimeoutError(f"port forward to {pod.name} not ready")
+            forwarders.append(fw)
+            for (lp, rp) in ports:
+                log.done(
+                    "[ports] %s:%d -> %s:%d", "127.0.0.1", lp, pod.name, rp
+                )
+    return forwarders
+
+
+class LogMux:
+    """Worker-prefixed log streaming across the slice
+    (replaces the reference's single-pod log follow)."""
+
+    def __init__(
+        self,
+        backend,
+        workers: list,
+        namespace: str,
+        container: Optional[str] = None,
+        tail: Optional[int] = 100,
+        out=None,
+        logger: Optional[logutil.Logger] = None,
+    ):
+        self.backend = backend
+        self.workers = workers
+        self.namespace = namespace
+        self.container = container
+        self.tail = tail
+        self.out = out or sys.stdout
+        self.log = logger or logutil.get_logger()
+        self._threads: list[threading.Thread] = []
+        self._stopped = threading.Event()
+        self._write_lock = threading.Lock()
+
+    def _prefix(self, pod) -> str:
+        wid = pod.tpu_worker_id
+        return f"[worker-{wid}] " if wid is not None else f"[{pod.name}] "
+
+    def run_once(self) -> None:
+        """Print the last `tail` lines of every worker (no follow)."""
+        for pod in self.workers:
+            prefix = self._prefix(pod)
+            for line in self.backend.logs(
+                pod, namespace=self.namespace, container=self.container, tail=self.tail
+            ):
+                with self._write_lock:
+                    self.out.write(prefix + line.decode("utf-8", "replace") + "\n")
+        if hasattr(self.out, "flush"):
+            self.out.flush()
+
+    def follow(self) -> None:
+        for pod in self.workers:
+            t = threading.Thread(target=self._follow_one, args=(pod,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _follow_one(self, pod) -> None:
+        prefix = self._prefix(pod)
+        try:
+            for line in self.backend.logs(
+                pod,
+                namespace=self.namespace,
+                container=self.container,
+                tail=self.tail,
+                follow=True,
+            ):
+                if self._stopped.is_set():
+                    return
+                with self._write_lock:
+                    self.out.write(prefix + line.decode("utf-8", "replace") + "\n")
+                    if hasattr(self.out, "flush"):
+                        self.out.flush()
+        except Exception as e:  # noqa: BLE001 — log stream ended
+            if not self._stopped.is_set():
+                self.log.warn("[logs] stream from %s ended: %s", pod.name, e)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+
+def start_terminal(
+    backend,
+    config: latest.Config,
+    command: Optional[list[str]] = None,
+    worker_index: Optional[int] = None,
+    stdin=None,
+    stdout=None,
+    logger: Optional[logutil.Logger] = None,
+) -> int:
+    """Interactive shell on one slice worker (reference:
+    services/terminal.go StartTerminal; command precedence args > config >
+    ``sh -c "bash || sh"``, terminal.go:29-33). Returns the exit code."""
+    log = logger or logutil.get_logger()
+    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
+    workers, ns, container = resolve_workers(
+        backend,
+        config,
+        tc.selector,
+        tc.label_selector,
+        tc.namespace,
+        tc.container_name,
+        timeout=POD_WAIT_TERMINAL if not config.tpu else POD_WAIT_SYNC,
+    )
+    idx = worker_index if worker_index is not None else (tc.worker or 0)
+    idx = max(0, min(idx, len(workers) - 1))
+    pod = workers[idx]
+    cmd = command or tc.command or ["sh", "-c", "bash || sh"]
+    log.info("[terminal] opening shell on %s (worker %d)", pod.name, idx)
+    use_tty = stdin is None and sys.stdin.isatty()
+    proc = backend.exec_stream(pod, cmd, container=container, tty=use_tty)
+    return _pump_terminal(proc, stdin=stdin, stdout=stdout, tty=use_tty)
+
+
+def _pump_terminal(proc, stdin=None, stdout=None, tty: bool = False) -> int:
+    """Bidirectional pump between the local terminal and the remote shell;
+    raw-TTY passthrough when interactive (reference: pkg/util/terminal)."""
+    stdout = stdout or sys.stdout
+    stop = threading.Event()
+
+    def pump_out():
+        while not stop.is_set():
+            try:
+                data = proc.stdout.read_available(timeout=0.2)
+            except Exception:  # noqa: BLE001 — stream closed
+                return
+            if data:
+                text = data.decode("utf-8", "replace")
+                stdout.write(text)
+                if hasattr(stdout, "flush"):
+                    stdout.flush()
+
+    def pump_err():
+        while not stop.is_set():
+            try:
+                data = proc.stderr.read_available(timeout=0.2)
+            except Exception:  # noqa: BLE001
+                return
+            if data:
+                sys.stderr.write(data.decode("utf-8", "replace"))
+                sys.stderr.flush()
+
+    threads = [threading.Thread(target=pump_out, daemon=True)]
+    if not tty:
+        threads.append(threading.Thread(target=pump_err, daemon=True))
+    for t in threads:
+        t.start()
+
+    raw_ctx = None
+    if tty:
+        raw_ctx = _raw_tty()
+        raw_ctx.__enter__()
+    try:
+        import time as _time
+
+        source = stdin if stdin is not None else sys.stdin.buffer
+
+        # stdin forwarding runs on its own daemon thread: a blocked
+        # readline() must never keep the session alive after the remote
+        # command exits.
+        def pump_in():
+            while not stop.is_set() and proc.poll() is None:
+                try:
+                    data = source.read(1) if tty else source.readline()
+                except (OSError, ValueError):
+                    return
+                if not data:
+                    return  # stdin EOF
+                if isinstance(data, str):
+                    data = data.encode()
+                try:
+                    proc.write_stdin(data)
+                except Exception:  # noqa: BLE001 — remote ended
+                    return
+
+        threading.Thread(target=pump_in, daemon=True).start()
+        while proc.poll() is None and not stop.is_set():
+            _time.sleep(0.05)
+        _time.sleep(0.1)  # let the output pumps drain the tail
+        rc = proc.poll()
+        return rc if rc is not None else 0
+    finally:
+        stop.set()
+        if raw_ctx is not None:
+            raw_ctx.__exit__(None, None, None)
+        proc.terminate()
+
+
+def _raw_tty():
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        import termios
+        import tty as ttymod
+
+        fd = sys.stdin.fileno()
+        old = termios.tcgetattr(fd)
+        try:
+            ttymod.setraw(fd)
+            yield
+        finally:
+            termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    return ctx()
+
+
+def start_attach(
+    backend,
+    config: latest.Config,
+    worker_index: int = 0,
+    stdout=None,
+    logger: Optional[logutil.Logger] = None,
+) -> int:
+    """Attach to a worker's main process (reference: services/attach.go —
+    the fallback when the terminal is disabled)."""
+    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
+    workers, ns, container = resolve_workers(
+        backend,
+        config,
+        tc.selector,
+        tc.label_selector,
+        tc.namespace,
+        tc.container_name,
+        timeout=POD_WAIT_ATTACH,
+    )
+    pod = workers[max(0, min(worker_index, len(workers) - 1))]
+    proc = backend.attach_stream(pod, container=container)
+    return _pump_terminal(proc, stdin=_EmptyStdin(), stdout=stdout, tty=False)
+
+
+class _EmptyStdin:
+    def readline(self):
+        import time
+
+        time.sleep(0.2)
+        return b""
+
+    def read(self, n):
+        return b""

@@ -58,9 +58,12 @@ def local_file_information(root: str, relpath: str) -> Optional[FileInformation]
 def find_command(remote_dir: str) -> str:
     """The remote snapshot command (reference: file_information.go:58)."""
     q = shlex.quote(remote_dir)
+    # `|| true`: find exits nonzero when a file vanishes between listing and
+    # stat (a normal race against concurrent uploads/removes); a partial
+    # snapshot is fine — the two-stable-polls rule prevents acting on it.
     return (
-        f"mkdir -p {q} && find -L {q} -exec stat -c '%n{SEPARATOR}%s,%Y,%f,%a,%u,%g' "
-        "{} + 2>/dev/null"
+        f"mkdir -p {q} && {{ find -L {q} -exec stat -c "
+        f"'%n{SEPARATOR}%s,%Y,%f,%a,%u,%g' {{}} + 2>/dev/null || true; }}"
     )
 
 

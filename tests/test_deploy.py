@@ -1,0 +1,280 @@
+import os
+
+import pytest
+import yaml
+
+from devspace_tpu.builder.builders import FakeBuilder, apply_entrypoint_override
+from devspace_tpu.builder.images import build_all, should_rebuild
+from devspace_tpu.builder.registry import create_pull_secret, init_registries, secret_name
+from devspace_tpu.config import latest
+from devspace_tpu.config.generated import CacheConfig
+from devspace_tpu.deploy.chart import ChartDeployer, ChartError, render_chart
+from devspace_tpu.deploy.manifests import (
+    ManifestDeployer,
+    deploy_all,
+    purge_all,
+    rewrite_image_tags,
+)
+from devspace_tpu.kube.fake import FakeCluster
+from devspace_tpu.utils.fsutil import write_file
+
+TPU_CHART = os.path.join(
+    os.path.dirname(__file__),
+    "..",
+    "devspace_tpu",
+    "generator",
+    "templates",
+    "chart-tpu",
+)
+
+
+# -- chart rendering --------------------------------------------------------
+def test_render_tpu_chart_multihost():
+    tpu = latest.TPUConfig(
+        accelerator="v5litepod-16", topology="4x4", workers=4, chips_per_worker=4
+    )
+    manifests = render_chart(
+        TPU_CHART,
+        release_name="trainer",
+        namespace="dev",
+        values={"image": "gcr.io/p/trainer:abc", "command": ["python", "train.py"]},
+        extra_context={
+            "images": {},
+            "pullSecrets": [],
+            "tpu": {
+                "accelerator": tpu.accelerator,
+                "topology": tpu.topology,
+                "workers": tpu.workers,
+                "chipsPerWorker": tpu.chips_per_worker,
+                "runtimeVersion": "",
+                "workerHostnames": "trainer-0.trainer,trainer-1.trainer,trainer-2.trainer,trainer-3.trainer",
+                "coordinatorAddress": "trainer-0.trainer:8476",
+            },
+        },
+    )
+    by_kind = {m["kind"]: m for m in manifests}
+    ss = by_kind["StatefulSet"]
+    assert ss["spec"]["replicas"] == 4  # native int preserved
+    assert ss["spec"]["serviceName"] == "trainer"
+    container = ss["spec"]["template"]["spec"]["containers"][0]
+    assert container["image"] == "gcr.io/p/trainer:abc"
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
+    env = {e["name"]: e for e in container["env"]}
+    assert "TPU_WORKER_ID" in env and "valueFrom" in env["TPU_WORKER_ID"]
+    assert env["TPU_WORKER_HOSTNAMES"]["value"].count(",") == 3
+    assert env["JAX_COORDINATOR_ADDRESS"]["value"] == "trainer-0.trainer:8476"
+    node_sel = ss["spec"]["template"]["spec"]["nodeSelector"]
+    assert node_sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+    svc = by_kind["Service"]
+    assert svc["spec"]["clusterIP"] is None or svc["spec"]["clusterIP"] == "None"
+    # release label stamped on everything
+    assert all(
+        m["metadata"]["labels"]["devspace.tpu/release"] == "trainer"
+        for m in manifests
+    )
+
+
+def test_render_unknown_path_errors(tmp_path):
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: c\n")
+    (chart / "templates" / "x.yaml").write_text("kind: ConfigMap\nmetadata: {name: '${{ values.nope }}'}\n")
+    with pytest.raises(ChartError, match="nope"):
+        render_chart(str(chart), "r", "default")
+
+
+# -- chart deploy lifecycle -------------------------------------------------
+def _deployment_config():
+    return latest.DeploymentConfig(
+        name="trainer",
+        chart=latest.ChartConfig(
+            path=TPU_CHART,
+            values={"image": "gcr.io/p/trainer", "command": ["sleep", "inf"]},
+        ),
+    )
+
+
+def test_chart_deploy_delete_status(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    cfg_tpu = latest.TPUConfig(workers=2, topology="2x4")
+    dep = ChartDeployer(fc, _deployment_config(), "default")
+    cache = CacheConfig()
+    assert dep.deploy(tpu=cfg_tpu, cache=cache) is True
+    # fake backend synthesized the slice pods from the StatefulSet
+    workers = fc.slice_workers({"app": "trainer"}, expected=2, timeout=5)
+    assert [p.tpu_worker_id for p in workers] == [0, 1]
+    # unchanged -> skipped
+    assert dep.deploy(tpu=cfg_tpu, cache=cache) is False
+    # changed values -> redeploy
+    dep.deployment.chart.values["command"] = ["python", "train.py"]
+    assert dep.deploy(tpu=cfg_tpu, cache=cache) is True
+    status = dep.status()
+    assert all(s["found"] for s in status) and len(status) >= 2
+    dep.delete()
+    assert fc.list_pods(label_selector={"app": "trainer"}) == []
+    assert all(not s["found"] for s in dep.status()) or dep.status() == []
+
+
+# -- manifest engine --------------------------------------------------------
+def test_manifest_deploy_with_image_rewrite(tmp_path):
+    fc = FakeCluster(str(tmp_path / "c"))
+    write_file(
+        str(tmp_path / "kube" / "app.yaml"),
+        yaml.safe_dump(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web"},
+                "spec": {
+                    "replicas": 1,
+                    "template": {
+                        "metadata": {"labels": {"app": "web"}},
+                        "spec": {"containers": [{"name": "m", "image": "gcr.io/p/web"}]},
+                    },
+                },
+            }
+        ),
+    )
+    d = latest.DeploymentConfig(
+        name="web", manifests=latest.ManifestsConfig(paths=["kube/*.yaml"])
+    )
+    dep = ManifestDeployer(fc, d, "default", base_dir=str(tmp_path))
+    dep.deploy(image_tags={"web": "gcr.io/p/web:tag123"})
+    obj = fc.get_object("apps/v1", "Deployment", "web", "default")
+    assert (
+        obj["spec"]["template"]["spec"]["containers"][0]["image"]
+        == "gcr.io/p/web:tag123"
+    )
+    dep.delete()
+    assert fc.get_object("apps/v1", "Deployment", "web", "default") is None
+
+
+def test_rewrite_image_tags_repo_match():
+    m = {"spec": {"containers": [{"image": "gcr.io/p/app:old"}, {"image": "other"}]}}
+    rewrite_image_tags(m, {"gcr.io/p/app": "gcr.io/p/app:new"})
+    assert m["spec"]["containers"][0]["image"] == "gcr.io/p/app:new"
+    assert m["spec"]["containers"][1]["image"] == "other"
+
+
+def test_deploy_all_and_purge(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    cfg = latest.Config(
+        version=latest.VERSION,
+        tpu=latest.TPUConfig(workers=2),
+        deployments=[_deployment_config()],
+    )
+    n = deploy_all(fc, cfg, "default", image_tags={"default": "gcr.io/p/trainer:xyz"})
+    assert n == 1
+    assert fc.slice_workers({"app": "trainer"}, expected=2, timeout=5)
+    purge_all(fc, cfg, "default")
+    assert fc.list_pods(label_selector={"app": "trainer"}) == []
+
+
+# -- build orchestration ----------------------------------------------------
+def test_build_all_with_cache(tmp_path):
+    write_file(str(tmp_path / "Dockerfile"), "FROM python:3.12\nCMD ['x']\n")
+    write_file(str(tmp_path / "src" / "app.py"), "print(1)")
+    cfg = latest.Config(
+        version=latest.VERSION,
+        images={
+            "default": latest.ImageConfig(
+                image="gcr.io/p/app", dockerfile="Dockerfile", context="."
+            )
+        },
+        dev=latest.DevConfig(
+            override_images=[
+                latest.ImageOverrideConfig(
+                    name="default", entrypoint=["sleep", "999999999"]
+                )
+            ]
+        ),
+    )
+    cache = CacheConfig()
+    builder = FakeBuilder()
+    tags = build_all(
+        cfg, cache, dev_mode=True, base_dir=str(tmp_path), builder_factory=lambda _: builder
+    )
+    assert len(builder.builds) == 1
+    assert builder.builds[0]["entrypoint_override"] == ["sleep", "999999999"]
+    assert tags["default"].startswith("gcr.io/p/app:")
+    tag1 = cache.image_tags["default"]
+    assert len(tag1) == 7
+    # second build: unchanged -> skipped, same tag
+    builder2 = FakeBuilder()
+    tags2 = build_all(
+        cfg, cache, dev_mode=True, base_dir=str(tmp_path), builder_factory=lambda _: builder2
+    )
+    assert builder2.builds == []
+    assert tags2["default"].endswith(tag1)
+    # edit context -> rebuild
+    write_file(str(tmp_path / "src" / "app.py"), "print(2)")
+    os_utime_bump(str(tmp_path / "src" / "app.py"))
+    builder3 = FakeBuilder()
+    build_all(
+        cfg, cache, dev_mode=False, base_dir=str(tmp_path), builder_factory=lambda _: builder3
+    )
+    assert len(builder3.builds) == 1
+    assert builder3.builds[0]["entrypoint_override"] is None
+    assert cache.image_tags["default"] != tag1
+
+
+def os_utime_bump(path):
+    import time
+
+    t = time.time() + 5
+    os.utime(path, (t, t))
+
+
+def test_entrypoint_override_rewrite():
+    df = "FROM python:3.12\nENTRYPOINT [\"python\"]\nCMD [\"app.py\"]\n"
+    out = apply_entrypoint_override(df, ["sleep", "inf"])
+    assert 'ENTRYPOINT ["sleep", "inf"]' in out
+    assert out.count("ENTRYPOINT") == 1 and "CMD" not in out
+
+
+# -- registry ---------------------------------------------------------------
+def test_pull_secret_creation(tmp_path, monkeypatch):
+    fc = FakeCluster(str(tmp_path))
+    name = create_pull_secret(fc, "default", "gcr.io", "user", "pass")
+    assert name == secret_name("gcr.io") == "devspace-auth-gcr-io"
+    secret = fc.get_object("v1", "Secret", name, "default")
+    assert secret["type"] == "kubernetes.io/dockerconfigjson"
+    import base64 as b64
+    import json
+
+    data = json.loads(b64.b64decode(secret["data"][".dockerconfigjson"]))
+    assert data["auths"]["gcr.io"]["username"] == "user"
+
+
+def test_init_registries_uses_docker_config(tmp_path, monkeypatch):
+    docker_dir = tmp_path / "docker"
+    docker_dir.mkdir()
+    import base64 as b64
+    import json
+
+    (docker_dir / "config.json").write_text(
+        json.dumps(
+            {"auths": {"gcr.io": {"auth": b64.b64encode(b"u:p").decode()}}}
+        )
+    )
+    monkeypatch.setenv("DOCKER_CONFIG", str(docker_dir))
+    fc = FakeCluster(str(tmp_path / "c"))
+    cfg = latest.Config(
+        version=latest.VERSION,
+        images={
+            "default": latest.ImageConfig(
+                image="gcr.io/p/app", create_pull_secret=True
+            )
+        },
+        deployments=[
+            latest.DeploymentConfig(
+                name="x",
+                namespace="other",
+                manifests=latest.ManifestsConfig(paths=[]),
+            )
+        ],
+    )
+    created = init_registries(fc, cfg, "default")
+    assert created == ["devspace-auth-gcr-io"]
+    assert fc.get_object("v1", "Secret", "devspace-auth-gcr-io", "default")
+    assert fc.get_object("v1", "Secret", "devspace-auth-gcr-io", "other")

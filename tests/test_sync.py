@@ -250,3 +250,40 @@ def test_rename_propagates(tmp_path, cluster):
     finally:
         session.stop()
     assert session.error is None
+
+
+def test_rate_limiter_smaller_than_chunk():
+    """A limit below the 64KiB chunk size must drain incrementally, not hang."""
+    from devspace_tpu.sync.shell import RateLimiter
+
+    rl = RateLimiter(50)  # 50 KB/s < 64 KiB chunk
+    t0 = time.monotonic()
+    rl.throttle(65536)  # first chunk partially pre-paid by initial allowance
+    rl.throttle(65536)
+    elapsed = time.monotonic() - t0
+    assert 1.0 < elapsed < 10.0  # ~1.3-2.6s expected; must terminate
+
+
+def test_remote_dir_delete_spares_local_edits(tmp_path, cluster):
+    session, local, workers = make_session(tmp_path, cluster, n_workers=1)
+    write_file(str(local / "d" / "f.txt"), "v1")
+    session.start()
+    try:
+        w0 = cluster.translate_path(workers[0], "/app")
+        wait_for(lambda: os.path.exists(os.path.join(w0, "d/f.txt")))
+        # pause upstream by editing right before remote delete
+        import shutil
+
+        shutil.rmtree(os.path.join(w0, "d"))
+        write_file(str(local / "d" / "f.txt"), "v2-local-edit-longer")
+        fut = time.time() + 5
+        os.utime(str(local / "d" / "f.txt"), (fut, fut))
+        # eventually upstream re-uploads the edited file; it must never be lost
+        wait_for(
+            lambda: os.path.exists(os.path.join(w0, "d/f.txt"))
+            and open(os.path.join(w0, "d/f.txt")).read() == "v2-local-edit-longer",
+            msg="local edit survives remote dir delete",
+        )
+        assert (local / "d" / "f.txt").read_text() == "v2-local-edit-longer"
+    finally:
+        session.stop()
