@@ -1,0 +1,23 @@
+"""MNIST-scale MLP — the smallest end-to-end training workload
+(examples/jax-mnist; north-star config 3)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256, 10)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):  # train: trainer-API parity
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, feat in enumerate(self.features):
+            x = nn.Dense(feat, dtype=self.dtype)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

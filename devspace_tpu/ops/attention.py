@@ -1,0 +1,108 @@
+"""Fused causal attention kernel (Pallas TPU) with jnp fallback.
+
+Query-blocked attention: the grid tiles (batch*heads, query blocks); each
+program holds its query tile plus the full K/V rows in VMEM, computes the
+masked scores on the MXU, softmaxes in f32, and writes one output tile.
+This fuses mask+softmax+two matmuls into one kernel (no [B,H,T,T] HBM
+round-trip). For sequence lengths beyond VMEM (≳8k) use the ring-attention
+path (parallel/ring_attention.py) which shards T across chips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import interpret_mode, use_pallas
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = True):
+    """q,k,v: [B, H, T, D] -> [B, H, T, D]."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, block_q: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D]
+    k = k_ref[0]  # [T, D]
+    v = v_ref[0]  # [T, D]
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    scores = (
+        jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [BQ, T]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores - m)
+    denom = jnp.sum(probs, axis=-1, keepdims=True)
+    probs = probs / denom
+    out = jax.lax.dot_general(
+        probs.astype(v.dtype),
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def attention_pallas(q, k, v, causal: bool = True, block_q: int = 256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    block_q = min(block_q, t)
+    if t % block_q:
+        return attention_reference(q, k, v, causal)
+    bh = b * h
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, t, d)
+    vf = v.reshape(bh, t, d)
+    grid = (bh, t // block_q)
+    out = pl.pallas_call(
+        functools.partial(_attention_kernel, causal=causal, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret_mode(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+def fused_attention(q, k, v, causal: bool = True):
+    """[B, H, T, D] attention; Pallas on TPU, reference elsewhere."""
+    if use_pallas() or interpret_mode():
+        return attention_pallas(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal)

@@ -1,0 +1,34 @@
+"""Kernel dispatch: Pallas on TPU, jnp fallback elsewhere.
+
+Every op in this package has two implementations with identical semantics:
+a Pallas TPU kernel (the fast path — fused, VMEM-resident, MXU-shaped) and
+a pure-jnp reference (correct everywhere; also what the kernel is tested
+against in interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_TPU_PLATFORMS = {"tpu", "axon"}
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in _TPU_PLATFORMS
+    except RuntimeError:
+        return False
+
+
+def use_pallas() -> bool:
+    forced = os.environ.get("DEVSPACE_PALLAS")  # "1" force on, "0" force off
+    if forced is not None:
+        return forced == "1"
+    return on_tpu()
+
+
+def interpret_mode() -> bool:
+    """Run kernels through the Pallas interpreter (CPU testing)."""
+    return os.environ.get("DEVSPACE_PALLAS_INTERPRET") == "1"

@@ -1,0 +1,62 @@
+"""Fused RMSNorm (Pallas TPU) with jnp fallback.
+
+One VMEM pass: mean-square, rsqrt, scale — no separate HBM round trips for
+the square/reduce/multiply. Rows are tiled on the grid; f32 accumulation
+regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import interpret_mode, use_pallas
+
+
+def rms_norm_reference(x, weight, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(ms + eps) * w_ref[:].astype(jnp.float32)).astype(
+        o_ref.dtype
+    )
+
+
+def rms_norm_pallas(x, weight, eps: float = 1e-5, block_rows: int = 256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = int(x.size // d)
+    xf = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        return rms_norm_reference(x, weight, eps)
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret_mode(),
+    )(xf, weight)
+    return out.reshape(orig_shape)
+
+
+def fused_rms_norm(x, weight, eps: float = 1e-5):
+    if use_pallas() or interpret_mode():
+        return rms_norm_pallas(x, weight, eps)
+    return rms_norm_reference(x, weight, eps)

@@ -1,0 +1,1 @@
+from .mesh import create_mesh, mesh_shape_for  # noqa: F401

@@ -1,0 +1,91 @@
+"""Device mesh construction for TPU slices.
+
+TPU-first design: all parallelism in this package is expressed as shardings
+over a named `jax.sharding.Mesh` (axes like data/model/seq/pipe); XLA
+inserts the collectives, which ride ICI inside a slice and DCN across
+slices (scaling-book recipe). The CLI side of the framework wires
+TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / JAX_COORDINATOR_ADDRESS into the
+pods (deploy/chart.py); :func:`multihost_initialize` consumes them here.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def mesh_shape_for(
+    n_devices: int, axes: dict[str, int]
+) -> dict[str, int]:
+    """Resolve -1 entries: the leftover device count goes to the (single)
+    -1 axis. ``axes`` preserves insertion order."""
+    known = 1
+    wildcard = None
+    for name, size in axes.items():
+        if size == -1:
+            if wildcard is not None:
+                raise ValueError("only one mesh axis may be -1")
+            wildcard = name
+        else:
+            known *= size
+    if wildcard is not None:
+        if n_devices % known:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes ({known})"
+            )
+        axes = {**axes, wildcard: n_devices // known}
+    total = math.prod(axes.values())
+    if total != n_devices:
+        raise ValueError(
+            f"mesh {axes} needs {total} devices but {n_devices} are available"
+        )
+    return axes
+
+
+def create_mesh(
+    axes: Optional[dict[str, int]] = None, devices=None
+) -> Mesh:
+    """Create a named mesh. Default: all devices on one ``data`` axis.
+
+    ``axes`` maps axis name -> size, one size may be -1 (inferred), e.g.
+    ``{"data": -1, "model": 2}`` on 8 devices -> data=4, model=2.
+    Device order follows ``jax.devices()`` which on TPU enumerates in
+    ICI-topology order — adjacent mesh coordinates are ICI neighbors, so
+    collectives over the innermost axis stay on the fastest links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = mesh_shape_for(len(devices), dict(axes or {"data": -1}))
+    dev_array = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def multihost_initialize(logger=None) -> bool:
+    """Initialize jax.distributed from the env our charts wire into TPU
+    slice pods (JAX_COORDINATOR_ADDRESS, TPU_WORKER_ID, JAX_NUM_PROCESSES).
+    No-op (returns False) outside a multi-host slice."""
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if not coordinator or n <= 1:
+        return False
+    pid = int(os.environ.get("TPU_WORKER_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=n, process_id=pid
+    )
+    if logger:
+        logger.info(
+            "[jax] distributed init: process %d/%d via %s", pid, n, coordinator
+        )
+    return True

@@ -1,0 +1,90 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis.
+
+GPipe-style schedule expressed the TPU way: every device holds one stage's
+params (sharded on ``pipe``), microbatches flow through a
+``jax.lax.scan`` over time steps, and activations hop to the next stage
+with ``jax.lax.ppermute`` (ICI neighbor transfer). With S stages and M
+microbatches the scan runs M + S - 1 ticks; device s computes on ticks
+s..s+M-1 — idle ticks multiply by a 0/1 mask instead of branching, which
+keeps the loop a single fused XLA while-op (no data-dependent control
+flow under jit).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    mesh: Mesh,
+    stage_fn: Callable,
+    axis: str = "pipe",
+):
+    """Build ``f(stage_params, x_microbatches) -> y_microbatches``.
+
+    ``stage_params``: pytree whose leaves have a leading stage dim S,
+    sharded over ``axis`` (each device sees its own stage's slice).
+    ``x_microbatches``: [M, mb, ...] replicated along ``axis``; returns
+    [M, mb, ...] outputs of the final stage (replicated).
+    ``stage_fn(params_one_stage, x) -> y`` must map activations to
+    activations of the same shape (classic homogeneous-stage pipeline).
+    """
+    n_stages = mesh.shape[axis]
+
+    def local_fn(params, xs):
+        # params leaves arrive with leading dim 1 (this device's stage).
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        total = n_micro + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            outputs, prev_act = carry
+            # Stage 0 feeds microbatch t (while t < M); later stages use
+            # the activation passed from the previous stage.
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x_in = jnp.where(stage == 0, xs[mb_idx], prev_act)
+            y = stage_fn(params, x_in)
+            # Validity: stage s works on tick t iff s <= t < s + M.
+            valid = jnp.logical_and(stage <= t, t < stage + n_micro)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            # Last stage stores its result for microbatch t - (S-1).
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            is_last = stage == n_stages - 1
+            store = jnp.logical_and(is_last, t >= n_stages - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(store, y, outputs[out_idx]),
+                out_idx,
+                axis=0,
+            )
+            # Activations hop to the next stage.
+            nxt = jax.lax.ppermute(y, axis, perm)
+            return (outputs, nxt), None
+
+        outputs = jnp.zeros_like(xs)
+        prev = jnp.zeros_like(xs[0])
+        (outputs, _), _ = jax.lax.scan(tick, (outputs, prev), jnp.arange(total))
+        # Only the last stage holds real outputs; broadcast via all_gather
+        # (ppermute forbids multicast from one source).
+        gathered = jax.lax.all_gather(outputs, axis)
+        return gathered[n_stages - 1]
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage pytrees into the leading-stage-dim layout that
+    pipeline_apply expects (shard the result over the pipe axis)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *param_list)

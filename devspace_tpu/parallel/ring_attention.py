@@ -1,0 +1,115 @@
+"""Ring attention: sequence parallelism for long context.
+
+The query sequence stays sharded over the ``seq`` mesh axis; key/value
+blocks rotate around the ring with ``jax.lax.ppermute`` while each device
+accumulates its queries' attention with an online-softmax (flash-style
+running max / sum / weighted-value accumulators). After S steps (S = ring
+size) every query block has attended to the full sequence, with peak
+memory O(seq/S) per device and the K/V transfers riding ICI neighbor
+links — the canonical TPU long-context layout.
+
+Causal masking uses global block offsets so the result matches full
+(unsharded) causal attention exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, scale, causal):
+    """Scores for one (q_block, kv_block) pair + masking.
+    q: [B, Tq, H, D], k/v: [B, Tkv, H, D] -> (scores [B,H,Tq,Tkv], v)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        tq, tkv = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(tq)[:, None]
+        kv_pos = kv_offset + jnp.arange(tkv)[None, :]
+        mask = q_pos >= kv_pos
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    return scores
+
+
+def ring_attention(
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = True,
+    batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
+):
+    """Build ``f(q, k, v) -> out`` with q/k/v [B, T, H, D] sharded on T
+    over ``axis``; out is sharded the same way. ``batch_axis``/``head_axis``
+    optionally co-shard B and H (composing sequence parallelism with data
+    and tensor parallelism in one mesh)."""
+    ring = mesh.shape[axis]
+    io_spec = P(batch_axis, axis, head_axis, None)
+
+    def local_fn(q, k, v):
+        idx = jax.lax.axis_index(axis)
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+        t_local = q.shape[1]
+        q_offset = idx * t_local
+
+        b, tq, h, d = q.shape
+        acc = jnp.zeros((b, h, tq, d), jnp.float32)
+        row_max = jnp.full((b, h, tq), NEG_INF, jnp.float32)
+        row_sum = jnp.zeros((b, h, tq), jnp.float32)
+
+        def step(carry, step_idx):
+            k_blk, v_blk, acc, row_max, row_sum = carry
+            kv_idx = (idx - step_idx) % ring  # whose block we hold now
+            kv_offset = kv_idx * t_local
+            scores = _block_attend(q, k_blk, v_blk, q_offset, kv_offset, scale, causal)
+            blk_max = jnp.max(scores, axis=-1)
+            new_max = jnp.maximum(row_max, blk_max)
+            # Guard fully-masked rows (new_max = -inf) against NaNs.
+            safe_max = jnp.where(new_max <= NEG_INF / 2, 0.0, new_max)
+            correction = jnp.exp(row_max - safe_max)
+            correction = jnp.where(row_max <= NEG_INF / 2, 0.0, correction)
+            probs = jnp.exp(scores - safe_max[..., None])
+            probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", probs, v_blk, preferred_element_type=jnp.float32
+            )
+            row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+            row_max = new_max
+            # Rotate K/V to the next device; ICI-neighbor transfer.
+            perm = [(i, (i + 1) % ring) for i in range(ring)]
+            k_next = jax.lax.ppermute(k_blk, axis, perm)
+            v_next = jax.lax.ppermute(v_blk, axis, perm)
+            return (k_next, v_next, acc, row_max, row_sum), None
+
+        (k_fin, v_fin, acc, row_max, row_sum), _ = jax.lax.scan(
+            step, (k, v, acc, row_max, row_sum), jnp.arange(ring)
+        )
+        denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
+        out = acc / denom[..., None]
+        return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(io_spec, io_spec, io_spec),
+        out_specs=io_spec,
+        check_vma=False,
+    )
+
+
+def full_attention(q, k, v, causal: bool = True):
+    """Unsharded reference attention (tests compare ring against this)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tkv = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tkv)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)

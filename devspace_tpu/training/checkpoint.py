@@ -1,0 +1,46 @@
+"""Checkpoint save/restore via Orbax.
+
+The reference's checkpoint/resume story is the generated-state cache for
+the dev loop (SURVEY §5.4) — model-weight checkpointing has no reference
+counterpart but is table stakes for the TPU workloads this framework
+scaffolds: multi-host-safe, sharding-aware save/restore."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
+    path = os.path.abspath(path)
+    _checkpointer().save(path, state, force=force)
+
+
+def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+    path = os.path.abspath(path)
+    if template is not None:
+        import orbax.checkpoint as ocp
+
+        return _checkpointer().restore(
+            path, args=ocp.args.PyTreeRestore(template)
+        )
+    return _checkpointer().restore(path)
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    """Step-numbered checkpoint dirs: root/step_000010 etc."""
+    try:
+        steps = sorted(
+            d for d in os.listdir(root) if d.startswith("step_")
+        )
+    except OSError:
+        return None
+    return os.path.join(root, steps[-1]) if steps else None

@@ -1,0 +1,167 @@
+"""Training loops for the scaffolded workloads and the benchmark.
+
+TPU-first: bf16 compute / f32 params, sharding-annotated jit steps (XLA
+inserts the ICI collectives), fused loss kernel, optional gradient
+accumulation via lax.scan (static trip count — no Python loops under jit).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.losses import fused_cross_entropy
+
+
+def cross_entropy_loss(logits, labels):
+    return jnp.mean(fused_cross_entropy(logits, labels))
+
+
+def make_classifier_train_step(
+    model_apply: Callable,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    has_batch_stats: bool = False,
+    donate: bool = True,
+):
+    """Train step for flax classifier models (MLP / ResNet).
+
+    ``model_apply(variables, images, train) -> logits`` (flax apply with
+    mutable batch_stats when has_batch_stats). State pytree:
+    {params, batch_stats?, opt_state, step}."""
+
+    def loss_fn(params, batch_stats, images, labels):
+        variables = {"params": params}
+        if has_batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, mutated = model_apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = model_apply(variables, images, train=True)
+            new_stats = batch_stats
+        return cross_entropy_loss(logits, labels), new_stats
+
+    def step_fn(state, batch):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], state.get("batch_stats"), batch["image"], batch["label"]
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            **state,
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        if has_batch_stats:
+            new_state["batch_stats"] = new_stats
+        return new_state, loss
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    repl = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P(data_axis))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, batch_shard),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_lm_train_step(
+    forward: Callable,
+    cfg,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    param_spec=None,
+    attention_fn=None,
+    donate: bool = True,
+):
+    """Causal-LM train step for the transformer: next-token prediction with
+    the fused cross-entropy. ``param_spec`` is a PartitionSpec tree for
+    tensor-parallel sharding (models.transformer.param_partition_spec)."""
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens[:, :-1], cfg, attention_fn=attention_fn)
+        b, t, v = logits.shape
+        losses = fused_cross_entropy(
+            logits.reshape(b * t, v), tokens[:, 1:].reshape(-1)
+        )
+        return jnp.mean(losses)
+
+    def step_fn(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            **state,
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    def to_sharding(spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            spec_tree,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+    repl = NamedSharding(mesh, P())
+    if param_spec is None:
+        state_sharding = repl
+    else:
+        # opt_state stays replicated here; for adam-scale optimizers shard
+        # it like the params at init time (its mu/nu mirror param shapes).
+        state_sharding = {
+            "params": to_sharding(param_spec),
+            "opt_state": repl,
+            "step": repl,
+        }
+    batch_shard = NamedSharding(mesh, P(data_axis))
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_sharding, batch_shard),
+        out_shardings=(state_sharding, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def accumulate_gradients(loss_fn: Callable, n_accum: int) -> Callable:
+    """Gradient accumulation via lax.scan over microbatches: trades HBM for
+    arithmetic without leaving the compiled step. ``loss_fn(params, batch)``
+    -> scalar; returns grad_fn(params, batch_with_leading_accum_dim)."""
+
+    def grad_fn(params, batches):
+        def micro(carry, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            acc_loss, acc_grads = carry
+            return (
+                acc_loss + loss / n_accum,
+                jax.tree_util.tree_map(
+                    lambda a, g: a + g / n_accum, acc_grads, grads
+                ),
+            ), None
+
+        zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (loss, grads), _ = jax.lax.scan(micro, (jnp.zeros(()), zero), batches)
+        return loss, grads
+
+    return grad_fn

@@ -1,0 +1,54 @@
+"""jax-mnist (BASELINE.md config 3): single-host TPU training.
+
+The minimum end-to-end TPU slice (SURVEY §7 step 6): `devspace-tpu dev`
+deploys this onto a v5e-1, syncs this file on every edit, and the
+auto-restarting loop below picks the change up — edit the LEARNING_RATE
+and watch the loss curve change on the next restart.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from devspace_tpu.models.mlp import MLP
+from devspace_tpu.parallel.mesh import create_mesh, multihost_initialize
+from devspace_tpu.training.data import synthetic_mnist
+from devspace_tpu.training.trainer import make_classifier_train_step
+
+LEARNING_RATE = 1e-3
+BATCH_SIZE = 256
+STEPS = 1000
+
+
+def main():
+    multihost_initialize()
+    print(f"devices: {jax.devices()}")
+    mesh = create_mesh({"data": -1})
+    model = MLP(features=(512, 256, 10))
+    batch_iter = synthetic_mnist(BATCH_SIZE)
+    first = next(batch_iter)
+    variables = model.init(jax.random.PRNGKey(0), first["image"])
+    optimizer = optax.adam(LEARNING_RATE)
+    state = {
+        "params": variables["params"],
+        "opt_state": optimizer.init(variables["params"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step_fn = make_classifier_train_step(model.apply, optimizer, mesh=mesh)
+    t0 = time.time()
+    for i in range(STEPS):
+        batch = next(batch_iter)
+        state, loss = step_fn(state, batch)
+        if i % 100 == 0:
+            print(
+                f"step {i:4d} loss {float(loss):.4f} "
+                f"({BATCH_SIZE * (i + 1) / (time.time() - t0):.0f} imgs/s)",
+                flush=True,
+            )
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

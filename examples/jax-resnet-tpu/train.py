@@ -1,0 +1,61 @@
+"""jax-resnet-tpu (BASELINE.md config 4): ResNet-50 data-parallel training
+on a multi-host v5e-16 slice.
+
+`devspace-tpu dev` fans the sync out to all 4 worker hosts; this process
+runs on every host, joins the slice via the TPU_WORKER_ID /
+JAX_COORDINATOR_ADDRESS env the chart wires in, and trains data-parallel
+over all 16 chips — gradients psum over ICI, inserted by XLA from the
+sharding annotations (the north star workload).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from devspace_tpu.models.resnet import ResNet50
+from devspace_tpu.parallel.mesh import create_mesh, multihost_initialize
+from devspace_tpu.training.data import synthetic_imagenet
+from devspace_tpu.training.trainer import make_classifier_train_step
+
+PER_CHIP_BATCH = 128
+STEPS = 500
+
+
+def main():
+    multihost_initialize()
+    n = jax.device_count()
+    print(f"process {jax.process_index()}/{jax.process_count()}, {n} chips")
+    mesh = create_mesh({"data": -1})
+    global_batch = PER_CHIP_BATCH * n
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    batch_iter = synthetic_imagenet(global_batch)
+    first = next(batch_iter)
+    variables = model.init(jax.random.PRNGKey(0), first["image"][:8], train=False)
+    optimizer = optax.sgd(0.1 * global_batch / 256, momentum=0.9)
+    state = {
+        "params": variables["params"],
+        "batch_stats": variables["batch_stats"],
+        "opt_state": optimizer.init(variables["params"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step_fn = make_classifier_train_step(
+        model.apply, optimizer, mesh=mesh, has_batch_stats=True
+    )
+    t0 = None
+    for i in range(STEPS):
+        batch = next(batch_iter)
+        state, loss = step_fn(state, batch)
+        if i == 0:
+            jax.block_until_ready(loss)
+            t0 = time.time()  # exclude compile
+        elif i % 20 == 0:
+            jax.block_until_ready(loss)
+            rate = global_batch * i / (time.time() - t0)
+            print(f"step {i:4d} loss {float(loss):.3f} {rate:.0f} imgs/sec", flush=True)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

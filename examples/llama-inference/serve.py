@@ -1,0 +1,83 @@
+"""llama-inference (BASELINE.md config 5): a JAX LLM inference server on a
+TPU pod, reached through `devspace-tpu dev`'s port-forward and health-checked
+by `devspace-tpu analyze`.
+
+Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N}) and
+/healthz. Defaults to the TINY config so it runs anywhere; set
+MODEL=llama2-7b on a real TPU pod with weights mounted.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from devspace_tpu.models import transformer as tfm
+
+CONFIGS = {"tiny": tfm.TINY, "llama2-7b": tfm.LLAMA2_7B, "llama2-13b": tfm.LLAMA2_13B}
+
+
+class Server:
+    def __init__(self):
+        name = os.environ.get("MODEL", "tiny")
+        self.cfg = CONFIGS[name]
+        print(f"loading {name} ({self.cfg.n_layers} layers) on {jax.devices()[0]}")
+        # Real deployments restore from a checkpoint
+        # (devspace_tpu.training.checkpoint); random weights keep the
+        # example self-contained.
+        self.params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
+        self.lock = threading.Lock()
+
+    def generate(self, prompt_ids, max_new_tokens):
+        prompt = jnp.asarray([prompt_ids], dtype=jnp.int32)
+        with self.lock:
+            out = tfm.generate(
+                self.params, prompt, self.cfg, max_new_tokens=max_new_tokens
+            )
+        return [int(t) for t in out[0]]
+
+
+def main():
+    import http.server
+
+    server = Server()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"ok": True, "model": os.environ.get("MODEL", "tiny")})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                tokens = server.generate(
+                    req["prompt_ids"], int(req.get("max_new_tokens", 16))
+                )
+                self._json(200, {"tokens": tokens})
+            except Exception as e:  # noqa: BLE001
+                self._json(400, {"error": str(e)})
+
+    print("serving on :8000")
+    http.server.ThreadingHTTPServer(("0.0.0.0", 8000), Handler).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Microservices backend: a tiny JSON API."""
+
+import http.server
+import json
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = json.dumps({"service": "backend", "ok": True}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+if __name__ == "__main__":
+    print("backend on :8000")
+    http.server.ThreadingHTTPServer(("0.0.0.0", 8000), Handler).serve_forever()

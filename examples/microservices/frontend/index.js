@@ -1,0 +1,18 @@
+// Microservices example (BASELINE.md config 2): frontend talks to the
+// backend service by its in-cluster DNS name.
+const http = require("http");
+
+const BACKEND = process.env.BACKEND_URL || "http://backend:8000";
+
+http
+  .createServer(async (req, res) => {
+    try {
+      const data = await fetch(BACKEND + "/api").then((r) => r.text());
+      res.writeHead(200, { "Content-Type": "text/plain" });
+      res.end("frontend -> " + data);
+    } catch (e) {
+      res.writeHead(502);
+      res.end("backend unreachable: " + e.message);
+    }
+  })
+  .listen(3000, () => console.log("frontend on :3000"));

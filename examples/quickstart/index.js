@@ -1,0 +1,11 @@
+// Quickstart (BASELINE.md config 1): Node.js single pod, CPU-only —
+// the baseline dev loop. Edit this file while `devspace-tpu dev` runs and
+// the change syncs into the container in well under a second.
+const http = require("http");
+
+const server = http.createServer((req, res) => {
+  res.writeHead(200, { "Content-Type": "text/plain" });
+  res.end("Hello from the devspace-tpu quickstart!\n");
+});
+
+server.listen(3000, () => console.log("listening on :3000"));

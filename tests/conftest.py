@@ -8,10 +8,30 @@ reference's fake-backend trick generalized — fake a TPU slice with
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force, don't setdefault: the driver environment pre-sets JAX_PLATFORMS to
+# the real TPU platform; tests always run on the virtual CPU slice.
+import re
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Force exactly 8 virtual devices, replacing any pre-set count — the tests
+# hard-require an 8-way mesh.
+flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+).strip()
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
 os.environ.setdefault("DEVSPACE_NONINTERACTIVE", "1")
+
+# The driver image ships a sitecustomize.py that pre-imports jax internals at
+# interpreter startup, freezing the platform default before this conftest
+# runs — there the env var alone is too late and we must force the platform
+# through the config API. On clean environments (no jax modules loaded yet)
+# the env vars above suffice and we skip the import cost for non-JAX tests.
+if any(m == "jax" or m.startswith(("jax.", "jaxlib")) for m in sys.modules):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

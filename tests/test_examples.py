@@ -1,0 +1,55 @@
+"""Every shipped example must have a loadable config and renderable charts."""
+
+import glob
+import os
+
+import pytest
+
+from devspace_tpu.config.loader import ConfigLoader
+from devspace_tpu.deploy.chart import render_chart
+
+EXAMPLES = sorted(
+    os.path.dirname(os.path.dirname(p))
+    for p in glob.glob(
+        os.path.join(os.path.dirname(__file__), "..", "examples", "*", ".devspace", "config.yaml")
+    )
+)
+
+
+@pytest.mark.parametrize("example", EXAMPLES, ids=[os.path.basename(e) for e in EXAMPLES])
+def test_example_config_loads_and_renders(example):
+    loader = ConfigLoader(example)
+    cfg = loader.load(interactive=False)
+    assert cfg.deployments
+    tpu_ctx = {
+        "accelerator": (cfg.tpu.accelerator if cfg.tpu else "") or "",
+        "topology": (cfg.tpu.topology if cfg.tpu else "") or "",
+        "workers": (cfg.tpu.workers if cfg.tpu else 1) or 1,
+        "chipsPerWorker": (cfg.tpu.chips_per_worker if cfg.tpu else 1) or 1,
+        "runtimeVersion": "",
+        "workerHostnames": "h0",
+        "coordinatorAddress": "h0:8476",
+    }
+    for d in cfg.deployments:
+        if d.chart:
+            values = dict(d.chart.values or {})
+            values.setdefault("image", "registry.local/test:tag")
+            manifests = render_chart(
+                os.path.join(example, d.chart.path),
+                release_name=d.name,
+                namespace="default",
+                values=values,
+                extra_context={"images": {}, "pullSecrets": [], "tpu": tpu_ctx},
+            )
+            assert manifests
+
+
+def test_examples_present():
+    names = {os.path.basename(e) for e in EXAMPLES}
+    assert {
+        "quickstart",
+        "microservices",
+        "jax-mnist",
+        "jax-resnet-tpu",
+        "llama-inference",
+    } <= names

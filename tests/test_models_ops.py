@@ -1,0 +1,123 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from devspace_tpu.models import transformer as tfm
+from devspace_tpu.models.mlp import MLP
+from devspace_tpu.models.resnet import ResNet50
+
+
+def test_mlp_forward():
+    model = MLP(features=(32, 10))
+    x = jnp.ones((4, 28, 28, 1))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (4, 10)
+
+
+def test_resnet50_forward_tiny():
+    model = ResNet50(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    # train mode mutates batch stats
+    out, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert "batch_stats" in mutated
+
+
+def test_transformer_forward_and_spec():
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = tfm.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    spec = tfm.param_partition_spec(cfg)
+    # spec tree matches param tree structure
+    jax.tree_util.tree_map(lambda p, s: None, params, spec)
+
+
+def test_transformer_decode_matches_forward():
+    """Incremental KV-cache decode must agree with the full forward."""
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    full_logits = tfm.forward(params, tokens, cfg)  # [1, 8, V]
+
+    cache = tfm.init_kv_cache(cfg, 1, 8)
+    step_logits = []
+    for i in range(8):
+        logits, cache = tfm.decode_step(params, cache, tokens[:, i : i + 1], cfg)
+        step_logits.append(logits)
+    step_logits = jnp.stack(step_logits, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full_logits), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_transformer_generate_greedy_deterministic():
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+    out1 = tfm.generate(params, prompt, cfg, max_new_tokens=5)
+    out2 = tfm.generate(params, prompt, cfg, max_new_tokens=5)
+    assert out1.shape == (1, 5)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_transformer_with_ring_attention():
+    """Sequence-parallel forward equals single-device forward."""
+    from devspace_tpu.parallel.mesh import create_mesh
+    from devspace_tpu.parallel.ring_attention import ring_attention
+
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    mesh = create_mesh({"seq": 8})
+    ring = ring_attention(mesh, causal=True)
+    ref = tfm.forward(params, tokens, cfg)
+    out = tfm.forward(params, tokens, cfg, attention_fn=ring)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+# -- pallas kernels in interpret mode ---------------------------------------
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    monkeypatch.setenv("DEVSPACE_PALLAS_INTERPRET", "1")
+
+
+def test_fused_attention_interpret(pallas_interpret):
+    from devspace_tpu.ops.attention import attention_pallas, attention_reference
+
+    b, h, t, d = 1, 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, t, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d), jnp.float32)
+    out = attention_pallas(q, k, v, causal=True, block_q=32)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_fused_rms_norm_interpret(pallas_interpret):
+    from devspace_tpu.ops.normalization import rms_norm_pallas, rms_norm_reference
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (128,), jnp.float32)
+    out = rms_norm_pallas(x, w, block_rows=32)
+    ref = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_cross_entropy_interpret(pallas_interpret):
+    from devspace_tpu.ops.losses import cross_entropy_pallas, cross_entropy_reference
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (32, 100), jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 100)
+    out = cross_entropy_pallas(logits, labels, block_rows=16)
+    ref = cross_entropy_reference(logits, labels)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)

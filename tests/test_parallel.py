@@ -1,0 +1,139 @@
+"""Parallelism layer tests on a virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8 — SURVEY §4's fake-slice trick)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from devspace_tpu.parallel.data_parallel import make_train_step, shard_batch
+from devspace_tpu.parallel.mesh import create_mesh, mesh_shape_for
+from devspace_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from devspace_tpu.parallel.ring_attention import full_attention, ring_attention
+from devspace_tpu.parallel.tensor_parallel import (
+    shard_columnwise,
+    shard_rowwise,
+    tp_mlp,
+)
+
+
+def test_mesh_shape_inference():
+    assert mesh_shape_for(8, {"data": -1}) == {"data": 8}
+    assert mesh_shape_for(8, {"data": -1, "model": 2}) == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        mesh_shape_for(8, {"data": 3, "model": 2})
+
+
+def test_mesh_creation():
+    mesh = create_mesh({"data": -1})
+    assert mesh.shape["data"] == 8
+    mesh2 = create_mesh({"data": 2, "model": 2, "seq": 2})
+    assert dict(mesh2.shape) == {"data": 2, "model": 2, "seq": 2}
+
+
+def test_data_parallel_step_matches_single_device():
+    mesh = create_mesh({"data": -1})
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (16, 4))
+    params = {"w": w}
+    xs = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    ys = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
+    batch = {"x": xs, "y": ys}
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean((pred - b["y"]) ** 2)
+
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    # single-device reference first — the step donates its inputs
+    ref_loss = float(loss_fn(params, batch))
+    grads = jax.grad(loss_fn)(params, batch)
+    ref = np.asarray(params["w"] - 0.1 * grads["w"])
+
+    step = make_train_step(loss_fn, opt, mesh)
+    sharded = shard_batch(batch, mesh)
+    params_dp = jax.device_put(params, jax.sharding.NamedSharding(mesh, P()))
+    opt_dp = jax.device_put(opt_state, jax.sharding.NamedSharding(mesh, P()))
+    new_params, _, loss = step(params_dp, opt_dp, sharded)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), ref, rtol=1e-5)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+
+
+def test_tp_mlp_matches_dense():
+    mesh = create_mesh({"model": 8})
+    key = jax.random.PRNGKey(0)
+    d, f = 16, 64
+    x = jax.random.normal(key, (4, d))
+    w_up = jax.random.normal(jax.random.PRNGKey(1), (d, f)) / np.sqrt(d)
+    w_down = jax.random.normal(jax.random.PRNGKey(2), (f, d)) / np.sqrt(f)
+    block = tp_mlp(mesh)
+    out = block(x, shard_columnwise(w_up, mesh), shard_rowwise(w_down, mesh))
+    ref = jax.nn.gelu(x @ w_up) @ w_down
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    mesh = create_mesh({"seq": 8})
+    b, t, h, d = 2, 64, 4, 8
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d), jnp.float32)
+    ring = ring_attention(mesh, causal=causal)
+    out = ring(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_matches_sequential():
+    mesh = create_mesh({"pipe": 8})
+    n_stages, n_micro, mb, dim = 8, 4, 2, 16
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    stage_params = [
+        {"w": jax.random.normal(k, (dim, dim)) / np.sqrt(dim)} for k in keys
+    ]
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    stacked = stack_stage_params(stage_params)
+    xs = jax.random.normal(jax.random.PRNGKey(9), (n_micro, mb, dim))
+    pipe = pipeline_apply(mesh, stage_fn)
+    out = pipe(stacked, xs)
+
+    ref = xs
+    for p in stage_params:
+        ref = jax.vmap(lambda x, p=p: stage_fn(p, x))(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_mnist_training_converges():
+    """End-to-end: data-parallel MLP training on the CPU mesh actually
+    learns the synthetic MNIST blobs (loss drops markedly)."""
+    import optax
+
+    from devspace_tpu.models.mlp import MLP
+    from devspace_tpu.training.data import synthetic_mnist
+    from devspace_tpu.training.trainer import make_classifier_train_step
+
+    mesh = create_mesh({"data": -1})
+    model = MLP(features=(64, 10))
+    batches = synthetic_mnist(64)
+    first = next(batches)
+    variables = model.init(jax.random.PRNGKey(0), first["image"])
+    opt = optax.adam(1e-3)
+    state = {
+        "params": variables["params"],
+        "opt_state": opt.init(variables["params"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_classifier_train_step(model.apply, opt, mesh=mesh)
+    losses = []
+    for _ in range(60):
+        state, loss = step(state, next(batches))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, f"no convergence: {losses[0]} -> {losses[-1]}"
