@@ -49,6 +49,14 @@ class Context:
         flag = getattr(self.args, "namespace", None)
         if flag:
             return flag
+        if self.config is not None and self.config.cluster and self.config.cluster.namespace:
+            return self.config.cluster.namespace
+        # Bound cloud Space: its service account is namespace-scoped, so the
+        # space namespace must win over the plain "default" fallback
+        # (reference: cloud.Configure re-binds config to the active space).
+        space = self.loader.generated.space
+        if space is not None and space.namespace:
+            return space.namespace
         if self.config is not None:
             return get_default_namespace(self.config)
         return "default"
@@ -81,6 +89,13 @@ class Context:
         context = getattr(self.args, "kube_context", None) or (
             cluster.kube_context if cluster else None
         )
+        if context is None:
+            # Bound cloud Space wins over the kubeconfig's current context
+            # (reference: cloud.Configure at the top of every command,
+            # cmd/dev.go:142 -> cloud/configure.go:79-118).
+            from ..cloud.configure import configure as cloud_configure
+
+            context = cloud_configure(self.loader.generated, self.log)
         transport = KubeTransport.from_kubeconfig(
             context=context, namespace=self.namespace
         )

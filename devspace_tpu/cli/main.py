@@ -377,6 +377,10 @@ def cmd_remove(args) -> int:
 # -- list -------------------------------------------------------------------
 def cmd_list(args) -> int:
     """Reference: cmd/list/*.go."""
+    if args.what == "spaces":
+        return cmd_list_spaces(args)
+    if args.what == "providers":
+        return cmd_list_providers(args)
     ctx = Context(args)
     cfg = ctx.config
     log = ctx.log
@@ -475,6 +479,178 @@ def cmd_use(args) -> int:
         cfg.cluster.namespace = args.name
         ctx.loader.save(cfg)
         log.done("[use] namespace: %s", args.name)
+    return 0
+
+
+# -- cloud ------------------------------------------------------------------
+def _provider(args):
+    """Build a Provider from the registry honoring --provider."""
+    from ..cloud.config import ProviderRegistry
+    from ..cloud.provider import Provider
+
+    registry = ProviderRegistry.load()
+    try:
+        entry = registry.get(getattr(args, "provider", None))
+    except KeyError as e:
+        raise CLIError(str(e.args[0])) from e
+    return Provider(entry, registry, logutil.get_logger()), registry
+
+
+def cmd_login(args) -> int:
+    """Reference: cmd/login.go — store a cloud access key."""
+    from ..cloud.provider import CloudError
+
+    provider, _ = _provider(args)
+    try:
+        provider.login(key=args.key, open_browser=not args.no_browser)
+    except CloudError as e:
+        logutil.get_logger().error(str(e))
+        return 1
+    return 0
+
+
+def cmd_create(args) -> int:
+    """Reference: cmd/create/space.go — create and bind a cloud Space."""
+    from ..cloud.configure import bind_space
+    from ..cloud.provider import CloudError
+
+    log = logutil.get_logger()
+    provider, _ = _provider(args)
+    try:
+        provider.ensure_logged_in()
+        space = provider.create_space(args.name)
+        log.done("[cloud] created space '%s' (id %d)", space.name, space.space_id)
+        if not args.no_use:
+            ctx = Context(args, require_config=False)
+            context = bind_space(provider, space, ctx.loader.generated)
+            log.done("[cloud] switched kube context to %s", context)
+    except CloudError as e:
+        log.error(str(e))
+        return 1
+    return 0
+
+
+def cmd_use_space(args) -> int:
+    """Reference: cmd/use/space.go — bind an existing Space."""
+    from ..cloud.configure import bind_space
+    from ..cloud.provider import CloudError
+
+    log = logutil.get_logger()
+    provider, _ = _provider(args)
+    try:
+        provider.ensure_logged_in()
+        space = provider.get_space(args.name)
+        ctx = Context(args, require_config=False)
+        context = bind_space(provider, space, ctx.loader.generated)
+        log.done("[cloud] using space '%s' (kube context %s)", space.name, context)
+    except CloudError as e:
+        log.error(str(e))
+        return 1
+    return 0
+
+
+def cmd_remove_space(args) -> int:
+    """Reference: cmd/remove/space.go — delete Space + local binding."""
+    from ..cloud.configure import remove_kube_context
+    from ..cloud.provider import CloudError
+
+    log = logutil.get_logger()
+    provider, _ = _provider(args)
+    try:
+        space = provider.get_space(args.name)
+        provider.delete_space(space.space_id)
+        remove_kube_context(space.name)
+        ctx = Context(args, require_config=False)
+        gen = ctx.loader.generated
+        if gen.space and gen.space.name == space.name:
+            gen.space = None
+            gen.save()
+        log.done("[cloud] removed space '%s'", space.name)
+    except CloudError as e:
+        log.error(str(e))
+        return 1
+    return 0
+
+
+def cmd_add_provider(args) -> int:
+    """Reference: cmd/add/provider.go."""
+    from ..cloud.config import CloudProvider, ProviderRegistry
+
+    registry = ProviderRegistry.load()
+    existing = registry.providers.get(args.name)
+    if existing is not None:
+        # Re-adding updates the host but keeps the stored credentials.
+        existing.host = args.host
+    else:
+        registry.providers[args.name] = CloudProvider(name=args.name, host=args.host)
+    if args.use_as_default:
+        registry.default = args.name
+    registry.save()
+    logutil.get_logger().done("[cloud] provider '%s' added", args.name)
+    return 0
+
+
+def cmd_remove_provider(args) -> int:
+    """Reference: cmd/remove/provider.go."""
+    from ..cloud.config import ProviderRegistry
+
+    log = logutil.get_logger()
+    registry = ProviderRegistry.load()
+    if args.name not in registry.providers:
+        log.error("unknown provider '%s'", args.name)
+        return 1
+    del registry.providers[args.name]
+    if registry.default == args.name:
+        from ..cloud.config import DEFAULT_PROVIDER_NAME
+
+        registry.default = DEFAULT_PROVIDER_NAME
+    registry.save()
+    log.done("[cloud] provider '%s' removed", args.name)
+    return 0
+
+
+def cmd_list_spaces(args) -> int:
+    """Reference: cmd/list/spaces.go."""
+    from ..cloud.provider import CloudError
+
+    log = logutil.get_logger()
+    provider, _ = _provider(args)
+    try:
+        spaces = provider.get_spaces()
+    except CloudError as e:
+        log.error(str(e))
+        return 1
+    root = find_root(os.getcwd())
+    bound = None
+    if root:
+        from ..config.generated import GeneratedConfig
+
+        gen = GeneratedConfig.load(root)
+        bound = gen.space.name if gen.space else None
+    log.print_table(
+        ["NAME", "ID", "NAMESPACE", "DOMAIN", "ACTIVE"],
+        [
+            [s.name, str(s.space_id), s.namespace, s.domain or "-",
+             "*" if s.name == bound else ""]
+            for s in spaces
+        ],
+    )
+    return 0
+
+
+def cmd_list_providers(args) -> int:
+    """Reference: cmd/list/providers (v4) — provider registry table."""
+    from ..cloud.config import ProviderRegistry
+
+    registry = ProviderRegistry.load()
+    logutil.get_logger().print_table(
+        ["NAME", "HOST", "LOGGED IN", "DEFAULT"],
+        [
+            [p.name, p.host, "yes" if p.key else "no",
+             "*" if p.name == registry.default else ""]
+            for p in registry.providers.values()
+        ],
+    )
     return 0
 
 
@@ -587,6 +763,11 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--dockerfile", default="Dockerfile")
     q.add_argument("--context", default=".")
     sp.set_defaults(fn=cmd_add)
+    q = add_sub.add_parser("provider", help="register a cloud provider")
+    q.add_argument("name")
+    q.add_argument("--host", required=True)
+    q.add_argument("--use-as-default", action="store_true")
+    q.set_defaults(fn=cmd_add_provider)
 
     sp = sub.add_parser("remove", help="remove config entries")
     rm_sub = sp.add_subparsers(dest="kind", required=True)
@@ -605,20 +786,49 @@ def build_parser() -> argparse.ArgumentParser:
     q = rm_sub.add_parser("image")
     q.add_argument("name")
     sp.set_defaults(fn=cmd_remove)
+    q = rm_sub.add_parser("space", help="delete a cloud space")
+    q.add_argument("name")
+    q.add_argument("--provider")
+    q.set_defaults(fn=cmd_remove_space)
+    q = rm_sub.add_parser("provider", help="deregister a cloud provider")
+    q.add_argument("name")
+    q.set_defaults(fn=cmd_remove_provider)
 
     sp = sub.add_parser("list", help="list config entries")
     sp.add_argument(
         "what",
-        choices=["deployments", "images", "ports", "sync", "selectors", "vars", "configs"],
+        choices=[
+            "deployments", "images", "ports", "sync", "selectors", "vars",
+            "configs", "spaces", "providers",
+        ],
     )
+    sp.add_argument("--provider")
     sp.set_defaults(fn=cmd_list)
 
-    sp = sub.add_parser("use", help="select config/context/namespace")
+    sp = sub.add_parser("use", help="select config/context/namespace/space")
     use_sub = sp.add_subparsers(dest="kind", required=True)
     for kind in ("config", "context", "namespace"):
         q = use_sub.add_parser(kind)
         q.add_argument("name")
+    q = use_sub.add_parser("space", help="bind a cloud space")
+    q.add_argument("name")
+    q.add_argument("--provider")
+    q.set_defaults(fn=cmd_use_space)
     sp.set_defaults(fn=cmd_use)
+
+    sp = sub.add_parser("login", help="log in to a cloud provider")
+    sp.add_argument("--key", help="access key (skips the browser flow)")
+    sp.add_argument("--provider")
+    sp.add_argument("--no-browser", action="store_true")
+    sp.set_defaults(fn=cmd_login)
+
+    sp = sub.add_parser("create", help="create cloud resources")
+    create_sub = sp.add_subparsers(dest="kind", required=True)
+    q = create_sub.add_parser("space")
+    q.add_argument("name")
+    q.add_argument("--provider")
+    q.add_argument("--no-use", action="store_true", help="create without binding")
+    q.set_defaults(fn=cmd_create)
 
     sp = sub.add_parser("update", help="rewrite config at the latest schema")
     sp.set_defaults(fn=cmd_update)

@@ -1,0 +1,9 @@
+"""Cloud provider layer — Spaces as managed TPU namespaces.
+
+Reference: pkg/devspace/cloud (SURVEY §2.8): provider registry in
+``~/.devspace/clouds.yaml``, GraphQL API client, browser token login,
+Space CRUD and space -> kubeconfig-context materialization.
+"""
+
+from .config import CloudProvider, ProviderRegistry  # noqa: F401
+from .provider import CloudError, Provider  # noqa: F401

@@ -69,7 +69,7 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, block_q: int)
     o_ref[0] = out.astype(o_ref.dtype)
 
 
-def attention_pallas(q, k, v, causal: bool = True, block_q: int = 256):
+def _attention_pallas_raw(q, k, v, causal: bool = True, block_q: int = 256):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -101,8 +101,36 @@ def attention_pallas(q, k, v, causal: bool = True, block_q: int = 256):
     return out.reshape(b, h, t, d)
 
 
-def fused_attention(q, k, v, causal: bool = True):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _attention(q, k, v, causal, block_q):
+    return _attention_pallas_raw(q, k, v, causal=causal, block_q=block_q)
+
+
+def _attention_fwd(q, k, v, causal, block_q):
+    return _attention_pallas_raw(q, k, v, causal=causal, block_q=block_q), (q, k, v)
+
+
+def _attention_bwd(causal, block_q, res, g):
+    # Backward recomputes attention with reference math — grads flow through
+    # plain einsums XLA schedules on the MXU. The saved residuals are just
+    # q/k/v (no [B,H,T,T] tensor is retained from the forward). A Pallas
+    # flash backward (dq/dk/dv blocked kernels) is the next optimization.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_attention.defvjp(_attention_fwd, _attention_bwd)
+
+
+def attention_pallas(q, k, v, causal: bool = True, block_q: int = 256):
+    if q.shape[2] % min(block_q, q.shape[2]):
+        return attention_reference(q, k, v, causal)
+    return _attention(q, k, v, causal, block_q)
+
+
+def fused_attention(q, k, v, causal: bool = True, block_q: int = 256):
     """[B, H, T, D] attention; Pallas on TPU, reference elsewhere."""
     if use_pallas() or interpret_mode():
-        return attention_pallas(q, k, v, causal=causal)
+        return attention_pallas(q, k, v, causal=causal, block_q=block_q)
     return attention_reference(q, k, v, causal=causal)

@@ -2,7 +2,10 @@
 
 Computes per-row ``logsumexp(logits) - logits[label]`` in one VMEM pass —
 the [B, V] probability matrix never materializes in HBM (for 32k vocabs
-that's the dominant memory traffic of the loss).
+that's the dominant memory traffic of the loss). Differentiable: a
+custom VJP saves only the logsumexp residual; the backward pass
+``(softmax - onehot) * g`` is a single fused elementwise+reduce XLA does
+well on its own.
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .dispatch import interpret_mode, use_pallas
 
@@ -23,42 +27,82 @@ def cross_entropy_reference(logits, labels):
     return lse - picked
 
 
-def _xent_kernel(logits_ref, labels_ref, o_ref):
+def _xent_kernel(logits_ref, labels_ref, o_ref, lse_ref):
+    # All refs are >=2-D: Mosaic maps 1-D blocks onto lane tilings that can
+    # disagree with the XLA layout of the parent array (observed on v5e for
+    # s32[B] with a half-array block), so labels/outputs ride as [BR, 1].
     logits = logits_ref[:].astype(jnp.float32)  # [BR, V]
-    labels = labels_ref[:]  # [BR]
+    labels = labels_ref[:]  # [BR, 1]
     m = jnp.max(logits, axis=-1, keepdims=True)
     shifted = logits - m
-    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[:, 0]
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)) + m  # [BR, 1]
     vocab_ids = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    onehot = (vocab_ids == labels[:, None]).astype(jnp.float32)
-    picked = jnp.sum(logits * onehot, axis=-1)
+    onehot = (vocab_ids == labels).astype(jnp.float32)
+    picked = jnp.sum(logits * onehot, axis=-1, keepdims=True)
     o_ref[:] = lse - picked
+    lse_ref[:] = lse
 
 
-def cross_entropy_pallas(logits, labels, block_rows: int = 128):
+def _xent_pallas_fwd(logits, labels, block_rows: int = 128):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, v = logits.shape
     block_rows = min(block_rows, b)
-    if b % block_rows:
-        return cross_entropy_reference(logits, labels)
-    return pl.pallas_call(
+    col = pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    loss, lse = pl.pallas_call(
         _xent_kernel,
-        out_shape=jax.ShapeDtypeStruct((b,), jnp.float32),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ),
         grid=(b // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_rows,), lambda i: (i,), memory_space=pltpu.VMEM),
+            col,
         ],
-        out_specs=pl.BlockSpec((block_rows,), lambda i: (i,), memory_space=pltpu.VMEM),
+        out_specs=(col, col),
         interpret=interpret_mode(),
-    )(logits, labels.astype(jnp.int32))
+    )(logits, labels.astype(jnp.int32).reshape(b, 1))
+    return loss[:, 0], lse[:, 0]
 
 
-def fused_cross_entropy(logits, labels):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _xent(logits, labels, block_rows):
+    loss, _ = _xent_fwd(logits, labels, block_rows)
+    return loss
+
+
+def _xent_fwd(logits, labels, block_rows):
+    b, _ = logits.shape
+    if (use_pallas() or interpret_mode()) and b % min(block_rows, b) == 0:
+        loss, lse = _xent_pallas_fwd(logits, labels, block_rows)
+    else:
+        f32 = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(f32, axis=-1)
+        picked = jnp.take_along_axis(f32, labels[:, None], axis=-1)[:, 0]
+        loss = lse - picked
+    return loss, (logits, labels, lse)
+
+
+def _xent_bwd(block_rows, res, g):
+    logits, labels, lse = res
+    probs = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    dlogits = ((probs - onehot) * g[:, None]).astype(logits.dtype)
+    return dlogits, np.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def cross_entropy_pallas(logits, labels, block_rows: int = 128):
+    return _xent(logits, labels, block_rows)
+
+
+def fused_cross_entropy(logits, labels, block_rows: int = 128):
     """Per-example losses [B] (take the mean outside; keeps reduction
     choice with the caller)."""
     if use_pallas() or interpret_mode():
-        return cross_entropy_pallas(logits, labels)
+        return _xent(logits, labels, block_rows)
     return cross_entropy_reference(logits, labels)

@@ -24,12 +24,13 @@ def rms_norm_reference(x, weight, eps: float = 1e-5):
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
     x = x_ref[:].astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    # w rides as [1, d] — 1-D blocks can hit Mosaic/XLA layout mismatches.
     o_ref[:] = (x * jax.lax.rsqrt(ms + eps) * w_ref[:].astype(jnp.float32)).astype(
         o_ref.dtype
     )
 
 
-def rms_norm_pallas(x, weight, eps: float = 1e-5, block_rows: int = 256):
+def _rms_pallas_raw(x, weight, eps: float = 1e-5, block_rows: int = 256):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -46,17 +47,53 @@ def rms_norm_pallas(x, weight, eps: float = 1e-5, block_rows: int = 256):
         grid=(rows // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
             (block_rows, d), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         interpret=interpret_mode(),
-    )(xf, weight)
+    )(xf, weight.reshape(1, d))
     return out.reshape(orig_shape)
 
 
-def fused_rms_norm(x, weight, eps: float = 1e-5):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm(x, weight, eps, block_rows):
+    return _rms_pallas_raw(x, weight, eps, block_rows)
+
+
+def _rms_fwd(x, weight, eps, block_rows):
+    return _rms_pallas_raw(x, weight, eps, block_rows), (x, weight)
+
+
+def _rms_bwd(eps, block_rows, res, g):
+    # Analytic backward in f32: with r = rsqrt(mean(x^2)+eps),
+    #   dx = r*(g*w) - x * r^3/d * sum(g*w*x),  dw = sum_rows(g * x * r).
+    # Pure elementwise+reduce — XLA fuses it into two HBM passes.
+    x, w = res
+    d = x.shape[-1]
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    gw = g32 * w32
+    dx = r * gw - x32 * (r**3 / d) * jnp.sum(gw * x32, axis=-1, keepdims=True)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dw = jnp.sum(g32 * x32 * r, axis=reduce_axes)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm_pallas(x, weight, eps: float = 1e-5, block_rows: int = 256):
+    rows = int(x.size // x.shape[-1])
+    if rows % min(block_rows, rows):
+        return rms_norm_reference(x, weight, eps)
+    return _rms_norm(x, weight, eps, block_rows)
+
+
+def fused_rms_norm(x, weight, eps: float = 1e-5, block_rows: int = 256):
     if use_pallas() or interpret_mode():
-        return rms_norm_pallas(x, weight, eps)
+        return rms_norm_pallas(x, weight, eps, block_rows)
     return rms_norm_reference(x, weight, eps)

@@ -1,0 +1,366 @@
+"""Cloud provider tests against an in-process fake GraphQL control plane.
+
+Reference test strategy (SURVEY §4): stand in for the remote side with a
+local process. Here the stand-in is a stdlib HTTP server speaking the same
+GraphQL contract as the provider client (manager_* operations).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from devspace_tpu.cloud.config import CloudProvider, ProviderRegistry
+from devspace_tpu.cloud.configure import (
+    bind_space,
+    configure,
+    kube_context_name,
+    remove_kube_context,
+)
+from devspace_tpu.cloud.provider import (
+    CloudError,
+    Provider,
+    parse_token_claims,
+    token_valid,
+)
+from devspace_tpu.config.generated import GeneratedConfig
+from devspace_tpu.kube.kubeconfig import KubeConfig
+
+VALID_KEY = "test-access-key"
+
+
+def make_jwt(exp_offset: float = 3600.0) -> str:
+    header = base64.urlsafe_b64encode(json.dumps({"alg": "none"}).encode()).decode()
+    claims = base64.urlsafe_b64encode(
+        json.dumps({"exp": time.time() + exp_offset, "sub": "tester"}).encode()
+    ).decode()
+    return f"{header.rstrip('=')}.{claims.rstrip('=')}.sig"
+
+
+class FakeCloud(http.server.BaseHTTPRequestHandler):
+    """GraphQL endpoint with an in-memory space table."""
+
+    spaces: dict[int, dict] = {}
+    next_id = 1
+
+    def do_POST(self):
+        if self.path != "/graphql":
+            self.send_response(404)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(length))
+        query = req.get("query", "")
+        variables = req.get("variables", {})
+        cls = type(self)
+
+        def reply(payload, status=200):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        if "manager_getToken" in query:
+            if variables.get("key") != VALID_KEY:
+                reply({"errors": [{"message": "invalid access key"}]})
+                return
+            reply({"data": {"manager_getToken": make_jwt()}})
+            return
+
+        # everything else requires a bearer token
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("Bearer ") or not token_valid(auth[7:], slack=0):
+            reply({"errors": [{"message": "unauthorized"}]})
+            return
+
+        if "manager_createSpace" in query:
+            sid = cls.next_id
+            cls.next_id += 1
+            space = {
+                "id": sid,
+                "name": variables["name"],
+                "namespace": f"space-{variables['name']}",
+                "created": "2026-01-01T00:00:00Z",
+                "domain": f"{variables['name']}.spaces.test",
+            }
+            cls.spaces[sid] = space
+            reply({"data": {"manager_createSpace": space}})
+        elif "manager_spaces" in query:
+            reply({"data": {"manager_spaces": list(cls.spaces.values())}})
+        elif "manager_deleteSpace" in query:
+            cls.spaces.pop(variables["id"], None)
+            reply({"data": {"manager_deleteSpace": True}})
+        elif "manager_serviceAccount" in query:
+            space = cls.spaces.get(variables["id"])
+            if not space:
+                reply({"errors": [{"message": "space not found"}]})
+                return
+            reply(
+                {
+                    "data": {
+                        "manager_serviceAccount": {
+                            "namespace": space["namespace"],
+                            "server": "https://1.2.3.4:6443",
+                            "caCert": base64.b64encode(b"FAKE-CA").decode(),
+                            "token": make_jwt(),
+                        }
+                    }
+                }
+            )
+        elif "manager_registryAuth" in query:
+            reply(
+                {
+                    "data": {
+                        "manager_registryAuth": {
+                            "registry": "registry.test",
+                            "username": "sa",
+                            "password": "pw",
+                        }
+                    }
+                }
+            )
+        else:
+            reply({"errors": [{"message": f"unknown operation: {query[:60]}"}]})
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def cloud_env(tmp_path, monkeypatch):
+    FakeCloud.spaces = {}
+    FakeCloud.next_id = 1
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeCloud)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host = f"http://127.0.0.1:{server.server_address[1]}"
+    clouds = tmp_path / "clouds.yaml"
+    kube = tmp_path / "kubeconfig"
+    monkeypatch.setenv("DEVSPACE_CLOUD_CONFIG", str(clouds))
+    monkeypatch.setenv("KUBECONFIG", str(kube))
+    registry = ProviderRegistry.load()
+    registry.providers["test"] = CloudProvider(name="test", host=host)
+    registry.default = "test"
+    registry.save()
+    yield {"host": host, "registry_path": str(clouds), "kube_path": str(kube),
+           "tmp": tmp_path}
+    server.shutdown()
+    server.server_close()
+
+
+def _provider(key: str | None = VALID_KEY) -> Provider:
+    registry = ProviderRegistry.load()
+    entry = registry.get("test")
+    entry.key = key
+    return Provider(entry, registry)
+
+
+def test_jwt_parse_and_validity():
+    token = make_jwt(3600)
+    claims = parse_token_claims(token)
+    assert claims["sub"] == "tester"
+    assert token_valid(token)
+    assert not token_valid(make_jwt(-10))
+    assert not token_valid(make_jwt(60))  # inside the 300s renewal slack
+    assert not token_valid("garbage")
+    assert not token_valid(None)
+
+
+def test_registry_roundtrip_and_default_provider(cloud_env):
+    registry = ProviderRegistry.load()
+    assert "test" in registry.providers
+    # the implicit default cloud entry always exists
+    from devspace_tpu.cloud.config import DEFAULT_PROVIDER_NAME
+
+    assert DEFAULT_PROVIDER_NAME in registry.providers
+    with pytest.raises(KeyError):
+        registry.get("nope")
+
+
+def test_login_with_key_and_token_refresh(cloud_env):
+    provider = _provider(key=None)
+    provider.login(key=VALID_KEY)
+    assert provider.entry.token is not None
+    # persisted
+    saved = ProviderRegistry.load().get("test")
+    assert saved.key == VALID_KEY
+    assert saved.token == provider.entry.token
+
+    # expired cached token is re-minted transparently
+    provider.entry.token = make_jwt(-10)
+    token = provider.token()
+    assert token_valid(token)
+
+
+def test_login_bad_key_fails(cloud_env):
+    provider = _provider(key=None)
+    with pytest.raises(CloudError, match="invalid access key"):
+        provider.login(key="wrong")
+
+
+def test_not_logged_in_error(cloud_env):
+    provider = _provider(key=None)
+    provider.entry.token = None
+    with pytest.raises(CloudError, match="not logged in"):
+        provider.token()
+
+
+def test_space_crud(cloud_env):
+    provider = _provider()
+    space = provider.create_space("dev1")
+    assert space.space_id == 1
+    assert space.namespace == "space-dev1"
+    spaces = provider.get_spaces()
+    assert [s.name for s in spaces] == ["dev1"]
+    assert provider.get_space("dev1").space_id == 1
+    assert provider.get_space("1").space_id == 1
+    with pytest.raises(CloudError, match="not found"):
+        provider.get_space("ghost")
+    provider.delete_space(space.space_id)
+    assert provider.get_spaces() == []
+
+
+def test_bind_space_materializes_kubeconfig(cloud_env):
+    provider = _provider()
+    space = provider.create_space("dev2")
+    generated = GeneratedConfig(str(cloud_env["tmp"]))
+    context = bind_space(provider, space, generated)
+    assert context == kube_context_name("dev2") == "devspace-dev2"
+
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert kc.current_context == "devspace-dev2"
+    cluster, user, ctx = kc.resolve()
+    assert cluster.server == "https://1.2.3.4:6443"
+    assert cluster.ca_data == b"FAKE-CA"
+    assert token_valid(user.token, slack=0)
+    assert ctx.namespace == "space-dev2"
+
+    # binding recorded in the generated cache (and survives reload)
+    reloaded = GeneratedConfig.load(str(cloud_env["tmp"]))
+    assert reloaded.space is not None
+    assert reloaded.space.name == "dev2"
+    assert reloaded.space.provider_name == "test"
+
+    remove_kube_context("dev2", cloud_env["kube_path"])
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert "devspace-dev2" not in kc.contexts
+    assert kc.current_context == ""
+
+
+def test_configure_refreshes_stale_space_token(cloud_env):
+    provider = _provider()
+    space = provider.create_space("dev3")
+    generated = GeneratedConfig(str(cloud_env["tmp"]))
+    bind_space(provider, space, generated)
+
+    # stale the cached space token; configure() must refresh it
+    generated.space.token = make_jwt(-10)
+    context = configure(generated)
+    assert context == "devspace-dev3"
+    assert token_valid(generated.space.token, slack=0)
+
+    # fresh token short-circuits (no API call needed): corrupt the host to
+    # prove configure doesn't hit the network when the token is valid
+    registry = ProviderRegistry.load()
+    registry.providers["test"].host = "http://127.0.0.1:1"
+    registry.save()
+    assert configure(generated) == "devspace-dev3"
+
+
+def test_configure_no_space_is_noop(cloud_env):
+    generated = GeneratedConfig(str(cloud_env["tmp"] / "other"))
+    assert configure(generated) is None
+
+
+def test_configure_unreachable_provider_uses_cache(cloud_env):
+    provider = _provider()
+    space = provider.create_space("dev4")
+    generated = GeneratedConfig(str(cloud_env["tmp"]))
+    bind_space(provider, space, generated)
+    generated.space.token = make_jwt(-10)
+    registry = ProviderRegistry.load()
+    registry.providers["test"].host = "http://127.0.0.1:1"
+    registry.save()
+    # degraded: warns and returns the cached context rather than dying
+    assert configure(generated) == "devspace-dev4"
+
+
+def test_registry_auth(cloud_env):
+    provider = _provider()
+    auth = provider.get_registry_auth()
+    assert auth == {"registry": "registry.test", "username": "sa", "password": "pw"}
+
+
+def test_cli_cloud_flow(cloud_env, tmp_path, monkeypatch):
+    """login --key -> create space -> list spaces -> remove space via CLI."""
+    from devspace_tpu.cli.main import main
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+
+    assert main(["login", "--key", VALID_KEY, "--provider", "test"]) == 0
+    assert main(["login", "--key", "wrong", "--provider", "test"]) == 1
+    assert main(["create", "space", "clidev", "--provider", "test"]) == 0
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert kc.current_context == "devspace-clidev"
+    assert main(["list", "spaces", "--provider", "test"]) == 0
+    assert main(["list", "providers"]) == 0
+    assert main(["use", "space", "clidev", "--provider", "test"]) == 0
+    assert main(["remove", "space", "clidev", "--provider", "test"]) == 0
+    assert FakeCloud.spaces == {}
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert "devspace-clidev" not in kc.contexts
+    # provider management
+    assert main(["add", "provider", "alt", "--host", "http://127.0.0.1:9"]) == 0
+    assert main(["remove", "provider", "alt"]) == 0
+    assert main(["remove", "provider", "ghost"]) == 1
+
+
+def test_cli_unknown_provider_is_clean_error(cloud_env, tmp_path, monkeypatch):
+    from devspace_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main(["login", "--provider", "nope", "--key", "x"]) == 1
+    assert main(["list", "spaces", "--provider", "nope"]) == 1
+
+
+def test_add_provider_preserves_credentials(cloud_env):
+    from devspace_tpu.cli.main import main
+
+    provider = _provider()
+    provider.login(key=VALID_KEY)
+    assert main(["add", "provider", "test", "--host", provider.entry.host]) == 0
+    saved = ProviderRegistry.load().get("test")
+    assert saved.key == VALID_KEY
+
+
+def test_context_namespace_uses_bound_space(cloud_env, tmp_path, monkeypatch):
+    """With a bound space and no explicit namespace, commands must target the
+    space's service-account namespace (it is namespace-scoped)."""
+    import argparse
+
+    from devspace_tpu.cli.context import Context
+
+    provider = _provider()
+    space = provider.create_space("nsdev")
+    proj = tmp_path / "nsproj"
+    (proj / ".devspace").mkdir(parents=True)
+    (proj / ".devspace" / "config.yaml").write_text("version: tpu/v1\n")
+    monkeypatch.chdir(proj)
+    generated = GeneratedConfig(str(proj))
+    bind_space(provider, space, generated)
+
+    args = argparse.Namespace(namespace=None, kube_context=None, config=None)
+    ctx = Context(args, require_config=False)
+    assert ctx.namespace == "space-nsdev"
+    # explicit flag still wins
+    args = argparse.Namespace(namespace="override", kube_context=None, config=None)
+    assert Context(args, require_config=False).namespace == "override"
