@@ -97,7 +97,8 @@ class IgnoreMatcher:
 
     def __init__(self, patterns: Iterable[str] = ()):
         self.rules: list[_Rule] = []
-        for raw in patterns:
+        self.patterns: list[str] = list(patterns)
+        for raw in self.patterns:
             line = raw.rstrip("\n")
             if not line.strip() or line.lstrip().startswith("#"):
                 continue

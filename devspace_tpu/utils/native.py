@@ -1,0 +1,176 @@
+"""ctypes loader for libdevsync — the native filesystem-scan fast path.
+
+The reference is a compiled Go binary; its local walks (initial-sync
+snapshot diff, downstream compare, build-context hashing) are native code.
+This module gives the Python framework the same property: ``native/``
+holds a small C++ library (built with g++ on first use) and everything
+here degrades to pure Python when it is unavailable
+(``DEVSPACE_NATIVE=0`` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import stat as statmod
+import subprocess
+import threading
+from typing import Iterator, NamedTuple, Optional
+
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+class WalkEntry(NamedTuple):
+    rel: str  # '/'-separated path relative to the walk root
+    size: int  # 0 for directories
+    mtime: int  # whole seconds
+    mtime_ns: int  # nanoseconds part
+    mode: int  # raw st_mode of the stat result (followed when requested)
+    uid: int
+    gid: int
+    is_symlink: bool  # from lstat — a followed link-to-dir is both dir+link
+
+    @property
+    def is_dir(self) -> bool:
+        return statmod.S_ISDIR(self.mode)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _lib_path() -> str:
+    return os.path.join(_repo_root(), "native", "build", "libdevsync.so")
+
+
+def _source_path() -> str:
+    return os.path.join(_repo_root(), "native", "devsync.cc")
+
+
+def _build() -> bool:
+    src = _source_path()
+    if not os.path.isfile(src):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.dirname(src)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.isfile(_lib_path())
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) libdevsync; None when unavailable."""
+    global _lib, _load_failed
+    if os.environ.get("DEVSPACE_NATIVE") == "0":
+        return None
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _lib_path()
+        src = _source_path()
+        stale = (
+            os.path.isfile(path)
+            and os.path.isfile(src)
+            and os.path.getmtime(src) > os.path.getmtime(path)
+        )
+        if (not os.path.isfile(path)) or stale:
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.ds_walk.restype = ctypes.c_void_p
+            lib.ds_walk.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+            lib.ds_free.argtypes = [ctypes.c_void_p]
+            lib.ds_abi_version.restype = ctypes.c_uint64
+            if lib.ds_abi_version() != _ABI_VERSION:
+                _load_failed = True
+                return None
+        except OSError:
+            _load_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def walk(
+    root: str,
+    prune: Optional[list[str]] = None,
+    follow_symlinks: bool = True,
+) -> Optional[Iterator[WalkEntry]]:
+    """Native recursive stat-walk of ``root``; None when the library is
+    unavailable (caller falls back to the Python walk). ``prune`` is a
+    list of directory *names* to skip entirely."""
+    lib = load()
+    if lib is None:
+        return None
+    csv = ",".join(prune or []).encode()
+    ptr = lib.ds_walk(root.encode(), csv, 1 if follow_symlinks else 0)
+    if not ptr:
+        return iter(())
+    try:
+        raw = ctypes.string_at(ptr).decode("utf-8", "surrogateescape")
+    finally:
+        lib.ds_free(ptr)
+    return _parse(raw)
+
+
+def _parse(raw: str) -> Iterator[WalkEntry]:
+    for line in raw.splitlines():
+        parts = line.split("\t")
+        if len(parts) != 8:
+            continue
+        try:
+            yield WalkEntry(
+                rel=parts[0],
+                size=int(parts[1]),
+                mtime=int(parts[2]),
+                mtime_ns=int(parts[3]),
+                mode=int(parts[4], 8),
+                uid=int(parts[5]),
+                gid=int(parts[6]),
+                is_symlink=parts[7] == "1",
+            )
+        except ValueError:
+            continue
+
+
+def prune_names(excludes: Optional[list[str]]) -> list[str]:
+    """Extract plain directory names from gitignore-style patterns — the
+    subset safe to prune inside the native walk (e.g. ``.git/``,
+    ``node_modules``). Anything with wildcards, slashes-in-the-middle or
+    negation stays a Python-side filter."""
+    # Any negation pattern could re-include a child of a pruned directory,
+    # so its presence disables native pruning wholesale.
+    if any((p or "").strip().startswith("!") for p in excludes or []):
+        return []
+    out = []
+    for p in excludes or []:
+        p = p.strip()
+        if not p or p.startswith("#"):
+            continue
+        # Root-anchored patterns ("/top") only match at the top level;
+        # pruning by bare name would also drop deeper dirs the matcher
+        # keeps, so they stay Python-side.
+        name = p.rstrip("/")
+        if not name or "/" in name or any(c in name for c in "*?[]"):
+            continue
+        out.append(name)
+    return out

@@ -1,0 +1,147 @@
+// libdevsync — native fast path for the sync engine's local filesystem scans.
+//
+// The reference implementation (hoatle/devspace, pkg/devspace/sync) is a Go
+// binary whose local walks are compiled code; this library keeps the
+// Python framework's hot loops (initial-sync snapshot, downstream compare,
+// build-context hashing — SURVEY §2.2/§2.5) at native speed. The Python
+// side (devspace_tpu/utils/native.py) loads it via ctypes and falls back to
+// pure Python when the library is absent.
+//
+// C ABI, one call: ds_walk(root, prune_csv, follow_symlinks) returns a
+// malloc'd NUL-terminated buffer of lines
+//   relpath\tsize\tmtime_sec\tmtime_ns\trawmode_oct\tuid\tgid\tis_symlink\n
+// (relpath '/'-separated; rawmode octal st_mode incl. file type bits, so
+// the Python layer derives is_dir like parse_stat_line does).
+// prune_csv: comma-separated directory *names* to skip entirely (fast-path
+// for excludes like .git, node_modules; full gitignore semantics stay in
+// Python). Free with ds_free.
+
+#include <dirent.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+#include <set>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Output {
+  char* buf = nullptr;
+  size_t len = 0;
+  size_t cap = 0;
+
+  void ensure(size_t extra) {
+    if (len + extra + 1 <= cap) return;
+    size_t want = (cap ? cap * 2 : 1 << 16);
+    while (want < len + extra + 1) want *= 2;
+    buf = static_cast<char*>(realloc(buf, want));
+    cap = want;
+  }
+
+  void append_line(const std::string& rel, const struct stat& st,
+                   bool is_symlink) {
+    // The symlink flag rides as its own column: a followed symlink-to-dir
+    // is both a directory (stat) and a link (lstat), and the exclusive
+    // file-type bits of st_mode cannot express that.
+    char meta[160];
+    int n = snprintf(meta, sizeof meta,
+                     "\t%lld\t%lld\t%lld\t%o\t%u\t%u\t%d\n",
+                     S_ISDIR(st.st_mode) ? 0LL
+                                         : static_cast<long long>(st.st_size),
+                     static_cast<long long>(st.st_mtim.tv_sec),
+                     static_cast<long long>(st.st_mtim.tv_nsec),
+                     static_cast<unsigned>(st.st_mode),
+                     static_cast<unsigned>(st.st_uid),
+                     static_cast<unsigned>(st.st_gid), is_symlink ? 1 : 0);
+    ensure(rel.size() + static_cast<size_t>(n));
+    memcpy(buf + len, rel.data(), rel.size());
+    len += rel.size();
+    memcpy(buf + len, meta, static_cast<size_t>(n));
+    len += static_cast<size_t>(n);
+  }
+};
+
+bool pruned(const std::vector<std::string>& prune, const char* name) {
+  for (const auto& p : prune)
+    if (p == name) return true;
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ABI version so the Python loader can refuse a stale build.
+uint64_t ds_abi_version() { return 1; }
+
+char* ds_walk(const char* root, const char* prune_csv, int follow_symlinks) {
+  std::vector<std::string> prune;
+  if (prune_csv && *prune_csv) {
+    const char* p = prune_csv;
+    while (*p) {
+      const char* comma = strchr(p, ',');
+      size_t n = comma ? static_cast<size_t>(comma - p) : strlen(p);
+      if (n) prune.emplace_back(p, n);
+      p += n + (comma ? 1 : 0);
+    }
+  }
+
+  Output out;
+  // (dev, ino) of visited directories — symlink cycle guard, mirrors
+  // walk_local_tree's seen_dirs set.
+  std::set<std::pair<uint64_t, uint64_t>> seen;
+  // stack of (abs_path, rel_path)
+  std::vector<std::pair<std::string, std::string>> stack;
+  stack.emplace_back(root, "");
+
+  while (!stack.empty()) {
+    auto [dir, rel_dir] = std::move(stack.back());
+    stack.pop_back();
+
+    DIR* d = opendir(dir.c_str());
+    if (!d) continue;
+    struct dirent* ent;
+    while ((ent = readdir(d)) != nullptr) {
+      const char* name = ent->d_name;
+      if (name[0] == '.' && (name[1] == 0 || (name[1] == '.' && name[2] == 0)))
+        continue;
+      std::string abs = dir;
+      if (abs.empty() || abs.back() != '/') abs += '/';
+      abs += name;
+      std::string rel = rel_dir.empty() ? name : rel_dir + "/" + name;
+
+      struct stat lst;
+      if (lstat(abs.c_str(), &lst) != 0) continue;
+      bool is_symlink = S_ISLNK(lst.st_mode);
+      struct stat st = lst;
+      if (is_symlink && follow_symlinks) {
+        if (stat(abs.c_str(), &st) != 0) continue;  // dangling link
+      }
+
+      if (S_ISDIR(st.st_mode)) {
+        if (pruned(prune, name)) continue;
+        out.append_line(rel, st, is_symlink);
+        auto key = std::make_pair(static_cast<uint64_t>(st.st_dev),
+                                  static_cast<uint64_t>(st.st_ino));
+        if (seen.insert(key).second) stack.emplace_back(abs, rel);
+      } else {
+        out.append_line(rel, st, is_symlink);
+      }
+    }
+    closedir(d);
+  }
+
+  out.ensure(0);
+  out.buf[out.len] = 0;
+  return out.buf;
+}
+
+void ds_free(char* p) { free(p); }
+
+}  // extern "C"

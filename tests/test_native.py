@@ -1,0 +1,113 @@
+"""Native fast-path tests: libdevsync builds, and its walk agrees exactly
+with the pure-Python implementations it accelerates.
+
+The reference keeps the whole sync engine native (Go); our invariant is
+weaker and testable: native and Python paths are interchangeable —
+identical walk_local_tree results and bit-identical directory hashes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from devspace_tpu.utils import native
+from devspace_tpu.utils.hashutil import directory_hash
+from devspace_tpu.utils.ignoreutil import IgnoreMatcher
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.fail("libdevsync failed to build — g++ toolchain is required")
+    return lib
+
+
+def build_tree(root):
+    os.makedirs(root / "src" / "nested", exist_ok=True)
+    os.makedirs(root / ".git" / "objects", exist_ok=True)
+    os.makedirs(root / "node_modules" / "pkg", exist_ok=True)
+    (root / "train.py").write_text("print('hi')\n")
+    (root / "src" / "model.py").write_text("x = 1\n")
+    (root / "src" / "nested" / "deep.txt").write_text("deep\n")
+    (root / ".git" / "objects" / "blob").write_text("blob\n")
+    (root / "node_modules" / "pkg" / "index.js").write_text("js\n")
+    (root / "data.bin").write_bytes(b"\x00" * 1024)
+    os.symlink("train.py", root / "link_to_file")
+    os.symlink("src", root / "link_to_dir")
+    os.symlink("missing-target", root / "dangling")
+
+
+def test_native_walk_matches_python_walk(lib, tmp_path, monkeypatch):
+    from devspace_tpu.sync.session import walk_local_tree
+
+    build_tree(tmp_path)
+    matcher = IgnoreMatcher([".git/", "node_modules", "*.bin"])
+
+    native_result = walk_local_tree(str(tmp_path), matcher)
+    monkeypatch.setattr(native, "walk", lambda *a, **k: None)
+    python_result = walk_local_tree(str(tmp_path), matcher)
+
+    assert set(native_result) == set(python_result)
+    for rel, info in python_result.items():
+        n = native_result[rel]
+        assert (n.size, n.mtime, n.is_directory, n.is_symlink) == (
+            info.size,
+            info.mtime,
+            info.is_directory,
+            info.is_symlink,
+        ), rel
+    assert "src/model.py" in native_result
+    assert "src/nested/deep.txt" in native_result
+    assert not any(r.startswith(".git") for r in native_result)
+    assert not any(r.startswith("node_modules") for r in native_result)
+    assert "data.bin" not in native_result
+    # symlinks: followed for stat, flagged as links
+    assert native_result["link_to_file"].is_symlink
+    assert native_result["link_to_dir"].is_directory
+    # symlinked dir contents appear (follow semantics) exactly like Python
+    assert ("link_to_dir/model.py" in native_result) == (
+        "link_to_dir/model.py" in python_result
+    )
+    assert "dangling" not in native_result  # dangling links are unstatable
+
+
+def test_directory_hash_native_matches_python(lib, tmp_path, monkeypatch):
+    build_tree(tmp_path)
+    excludes = [".git/", "node_modules"]
+    h_native = directory_hash(str(tmp_path), excludes)
+    monkeypatch.setattr(native, "walk", lambda *a, **k: None)
+    h_python = directory_hash(str(tmp_path), excludes)
+    assert h_native == h_python
+
+    # hash reacts to edits either way
+    (tmp_path / "train.py").write_text("print('changed')\n")
+    os.utime(tmp_path / "train.py", ns=(1, 10**18))
+    assert directory_hash(str(tmp_path), excludes) != h_python
+
+
+def test_symlink_cycle_terminates(lib, tmp_path):
+    from devspace_tpu.sync.session import walk_local_tree
+
+    os.makedirs(tmp_path / "a" / "b")
+    os.symlink(str(tmp_path / "a"), tmp_path / "a" / "b" / "loop")
+    result = walk_local_tree(str(tmp_path), None)
+    assert "a/b" in result  # finished without spinning
+
+
+def test_prune_names():
+    assert native.prune_names([".git/", "node_modules", "*.pyc", "a/b", "/top"]) == [
+        ".git",
+        "node_modules",
+    ]
+    # negations disable pruning entirely
+    assert native.prune_names([".git/", "!keep"]) == []
+    assert native.prune_names(None) == []
+
+
+def test_disable_via_env(lib, monkeypatch):
+    monkeypatch.setenv("DEVSPACE_NATIVE", "0")
+    assert native.load() is None
+    assert native.walk("/tmp") is None
