@@ -381,6 +381,8 @@ def cmd_list(args) -> int:
         return cmd_list_spaces(args)
     if args.what == "providers":
         return cmd_list_providers(args)
+    if args.what == "packages":
+        return cmd_list_packages(args)
     ctx = Context(args)
     cfg = ctx.config
     log = ctx.log
@@ -479,6 +481,85 @@ def cmd_use(args) -> int:
         cfg.cluster.namespace = args.name
         ctx.loader.save(cfg)
         log.done("[use] namespace: %s", args.name)
+    return 0
+
+
+# -- packages ---------------------------------------------------------------
+def _chart_dir(ctx: Context) -> str:
+    """The first chart deployment's chart dir (default ./chart)."""
+    for d in ctx.config.deployments or []:
+        if d.chart and d.chart.path:
+            return os.path.join(ctx.root, d.chart.path)
+    return os.path.join(ctx.root, "chart")
+
+
+def _package_repo(args) -> str:
+    repo = getattr(args, "repo", None) or os.environ.get("DEVSPACE_CHART_REPO")
+    if not repo:
+        raise CLIError(
+            "no chart repo — pass --repo or set DEVSPACE_CHART_REPO"
+        )
+    return repo
+
+
+def cmd_add_package(args) -> int:
+    """Reference: cmd/add/package.go -> configure/package.go."""
+    from ..deploy.packages import PackageError, add_package, search_charts
+
+    ctx = Context(args)
+    try:
+        add_package(
+            _chart_dir(ctx), _package_repo(args), args.name, args.version, ctx.log
+        )
+    except PackageError as e:
+        ctx.log.error(str(e))
+        try:
+            hits = search_charts(_package_repo(args), args.name)
+            if hits:
+                ctx.log.info(
+                    "did you mean: %s", ", ".join(h.name for h in hits[:5])
+                )
+        except (PackageError, CLIError):
+            pass
+        return 1
+    return 0
+
+
+def cmd_remove_package(args) -> int:
+    from ..deploy.packages import remove_package
+
+    ctx = Context(args)
+    return 0 if remove_package(_chart_dir(ctx), args.name, ctx.log) else 1
+
+
+def cmd_list_packages(args) -> int:
+    from ..deploy.packages import list_packages
+
+    ctx = Context(args)
+    ctx.log.print_table(
+        ["NAME", "VERSION", "REPOSITORY", "VENDORED"],
+        [
+            [p["name"], p["version"], p["repository"], "yes" if p["vendored"] else "MISSING"]
+            for p in list_packages(_chart_dir(ctx))
+        ],
+    )
+    return 0
+
+
+def cmd_search(args) -> int:
+    """Reference: helm/search.go — chart repo search."""
+    from ..deploy.packages import PackageError, search_charts
+
+    log = logutil.get_logger()
+    try:
+        hits = search_charts(_package_repo(args), args.query or "")
+    except PackageError as e:
+        log.error(str(e))
+        return 1
+    log.print_table(
+        ["NAME", "VERSION", "DESCRIPTION"],
+        [[h.name, h.version, h.description] for h in hits],
+    )
     return 0
 
 
@@ -768,6 +849,11 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--host", required=True)
     q.add_argument("--use-as-default", action="store_true")
     q.set_defaults(fn=cmd_add_provider)
+    q = add_sub.add_parser("package", help="vendor a chart from a repo")
+    q.add_argument("name")
+    q.add_argument("--repo", help="chart repo (dir, file:// or http(s)://)")
+    q.add_argument("--version")
+    q.set_defaults(fn=cmd_add_package)
 
     sp = sub.add_parser("remove", help="remove config entries")
     rm_sub = sp.add_subparsers(dest="kind", required=True)
@@ -793,17 +879,25 @@ def build_parser() -> argparse.ArgumentParser:
     q = rm_sub.add_parser("provider", help="deregister a cloud provider")
     q.add_argument("name")
     q.set_defaults(fn=cmd_remove_provider)
+    q = rm_sub.add_parser("package", help="remove a vendored chart")
+    q.add_argument("name")
+    q.set_defaults(fn=cmd_remove_package)
 
     sp = sub.add_parser("list", help="list config entries")
     sp.add_argument(
         "what",
         choices=[
             "deployments", "images", "ports", "sync", "selectors", "vars",
-            "configs", "spaces", "providers",
+            "configs", "spaces", "providers", "packages",
         ],
     )
     sp.add_argument("--provider")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("search", help="search a chart repo")
+    sp.add_argument("query", nargs="?")
+    sp.add_argument("--repo", help="chart repo (dir, file:// or http(s)://)")
+    sp.set_defaults(fn=cmd_search)
 
     sp = sub.add_parser("use", help="select config/context/namespace/space")
     use_sub = sp.add_subparsers(dest="kind", required=True)

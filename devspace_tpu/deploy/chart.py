@@ -116,6 +116,40 @@ def render_chart(
         "chart": meta,
         **(extra_context or {}),
     }
+    manifests = _render_templates(chart_path, context, release_name, namespace)
+
+    # Vendored packages (deploy/packages.py add_package): each renders with
+    # its own defaults overridden by the parent's values.packages.<name>,
+    # sharing the release/extra context so its pods join the same release.
+    packages_dir = os.path.join(chart_path, "packages")
+    if os.path.isdir(packages_dir):
+        for pkg_name in sorted(os.listdir(packages_dir)):
+            pkg_dir = os.path.join(packages_dir, pkg_name)
+            if not os.path.isfile(os.path.join(pkg_dir, "chart.yaml")):
+                continue
+            pkg_values: dict = {}
+            pkg_defaults = os.path.join(pkg_dir, "values.yaml")
+            if os.path.isfile(pkg_defaults):
+                with open(pkg_defaults, "r", encoding="utf-8") as fh:
+                    pkg_values = yaml.safe_load(fh) or {}
+            overrides = (merged_values.get("packages") or {}).get(pkg_name) or {}
+            pkg_context = {
+                **context,
+                "values": merge(pkg_values, overrides),
+                "chart": load_chart(pkg_dir),
+            }
+            manifests.extend(
+                _render_templates(pkg_dir, pkg_context, release_name, namespace)
+            )
+
+    if not manifests:
+        raise ChartError(f"chart {chart_path} rendered no manifests")
+    return manifests
+
+
+def _render_templates(
+    chart_path: str, context: dict, release_name: str, namespace: str
+) -> list[dict]:
     manifests: list[dict] = []
     template_dir = os.path.join(chart_path, "templates")
     for path in sorted(glob.glob(os.path.join(template_dir, "*.yaml"))) + sorted(
@@ -137,8 +171,6 @@ def render_chart(
             labels = rendered["metadata"].setdefault("labels", {})
             labels.setdefault("devspace.tpu/release", release_name)
             manifests.append(rendered)
-    if not manifests:
-        raise ChartError(f"chart {chart_path} rendered no manifests")
     return manifests
 
 

@@ -1,0 +1,295 @@
+"""Chart package management — dependencies from chart repositories.
+
+Reference: ``devspace add package`` (cmd/add/package.go ->
+pkg/devspace/configure/package.go:25-253: merges a helm chart into
+chart/requirements.yaml and appends its values) and chart-repo search
+(pkg/devspace/helm/search.go). Redesigned for our chart format:
+
+- A **repo** is a directory / ``file://`` / ``http(s)://`` URL containing
+  ``index.yaml``::
+
+      entries:
+        redis:
+          - version: "1.0.0"
+            description: in-memory store
+            path: charts/redis        # chart dir, local/file repos
+            archive: redis-1.0.0.tgz  # OR a tarball, http repos
+
+- ``add_package`` vendors the chart into ``<chart>/packages/<name>/`` and
+  records it in ``<chart>/requirements.yaml``; the renderer picks every
+  vendored package up automatically, scoping its values under
+  ``values.packages.<name>``.
+
+Vendoring (not helm's install-time fetch) keeps deploys hermetic — the
+right call in a zero-egress TPU-pod world.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import tempfile
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+import yaml
+
+from ..utils import log as logutil
+
+REQUIREMENTS_FILE = "requirements.yaml"
+PACKAGES_DIR = "packages"
+
+
+class PackageError(Exception):
+    pass
+
+
+@dataclass
+class ChartEntry:
+    name: str
+    version: str
+    description: str = ""
+    path: Optional[str] = None
+    archive: Optional[str] = None
+
+
+def _is_url(repo: str) -> bool:
+    return repo.startswith(("http://", "https://", "file://"))
+
+
+def _read_repo_file(repo: str, relpath: str) -> bytes:
+    """Read a file from a repo (dir, file:// or http(s)://)."""
+    if _is_url(repo):
+        url = repo.rstrip("/") + "/" + urllib.parse.quote(relpath)
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                return resp.read()
+        except OSError as e:
+            raise PackageError(f"cannot read {url}: {e}") from e
+    path = os.path.join(repo, relpath)
+    try:
+        with open(path, "rb") as fh:
+            return fh.read()
+    except OSError as e:
+        raise PackageError(f"cannot read {path}: {e}") from e
+
+
+def load_index(repo: str) -> dict[str, list[ChartEntry]]:
+    """Parse the repo's index.yaml into {name: [entries newest-first]}."""
+    try:
+        raw = yaml.safe_load(_read_repo_file(repo, "index.yaml")) or {}
+    except yaml.YAMLError as e:
+        raise PackageError(f"invalid index.yaml in {repo}: {e}") from e
+    out: dict[str, list[ChartEntry]] = {}
+    for name, versions in (raw.get("entries") or {}).items():
+        entries = []
+        for v in versions or []:
+            entries.append(
+                ChartEntry(
+                    name=name,
+                    version=str(v.get("version", "0")),
+                    description=v.get("description", ""),
+                    path=v.get("path"),
+                    archive=v.get("archive"),
+                )
+            )
+        entries.sort(key=lambda e: _version_key(e.version), reverse=True)
+        out[name] = entries
+    return out
+
+
+def _version_key(version: str) -> tuple:
+    parts = []
+    for p in version.lstrip("v").split("."):
+        try:
+            parts.append((0, int(p)))
+        except ValueError:
+            parts.append((1, p))
+    return tuple(parts)
+
+
+def search_charts(repo: str, query: str = "") -> list[ChartEntry]:
+    """Newest version of every chart matching ``query`` (substring over
+    name+description; reference: helm/search.go)."""
+    query = query.lower()
+    hits = []
+    for name, entries in sorted(load_index(repo).items()):
+        if not entries:
+            continue
+        newest = entries[0]
+        if query in name.lower() or query in newest.description.lower():
+            hits.append(newest)
+    return hits
+
+
+def resolve(repo: str, name: str, version: Optional[str] = None) -> ChartEntry:
+    index = load_index(repo)
+    entries = index.get(name)
+    if not entries:
+        available = ", ".join(sorted(index)) or "none"
+        raise PackageError(f"chart '{name}' not found in {repo} (available: {available})")
+    if version is None:
+        return entries[0]
+    for e in entries:
+        if e.version == version:
+            return e
+    raise PackageError(
+        f"chart '{name}' has no version {version} "
+        f"(available: {', '.join(e.version for e in entries)})"
+    )
+
+
+def _fetch_chart(repo: str, entry: ChartEntry, dest: str) -> None:
+    """Materialize the chart directory at ``dest``."""
+    if entry.path and not _is_url(repo):
+        src = os.path.join(repo, entry.path)
+        if not os.path.isdir(src):
+            raise PackageError(f"repo entry path missing: {src}")
+        shutil.copytree(src, dest)
+        return
+    if entry.path and repo.startswith("file://"):
+        src = os.path.join(urllib.parse.urlparse(repo).path, entry.path)
+        if not os.path.isdir(src):
+            raise PackageError(f"repo entry path missing: {src}")
+        shutil.copytree(src, dest)
+        return
+    if not entry.archive:
+        raise PackageError(
+            f"chart '{entry.name}' {entry.version}: http repos need an 'archive' entry"
+        )
+    blob = _read_repo_file(repo, entry.archive)
+    with tempfile.TemporaryDirectory() as tmp:
+        tarball = os.path.join(tmp, "chart.tgz")
+        with open(tarball, "wb") as fh:
+            fh.write(blob)
+        with tarfile.open(tarball, "r:gz") as tf:
+            # refuse path escapes before extracting anything
+            for m in tf.getmembers():
+                target = os.path.normpath(os.path.join(tmp, "x", m.name))
+                if not target.startswith(os.path.join(tmp, "x")):
+                    raise PackageError(f"archive member escapes: {m.name}")
+            tf.extractall(os.path.join(tmp, "x"), filter="data")
+        extracted = os.path.join(tmp, "x")
+        # archives may wrap the chart in a single top-level dir
+        entries = os.listdir(extracted)
+        root = (
+            os.path.join(extracted, entries[0])
+            if len(entries) == 1 and os.path.isdir(os.path.join(extracted, entries[0]))
+            else extracted
+        )
+        if not os.path.isfile(os.path.join(root, "chart.yaml")):
+            raise PackageError(f"archive for '{entry.name}' contains no chart.yaml")
+        shutil.copytree(root, dest)
+
+
+# -- requirements bookkeeping -------------------------------------------------
+def load_requirements(chart_dir: str) -> list[dict]:
+    path = os.path.join(chart_dir, REQUIREMENTS_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return (yaml.safe_load(fh) or {}).get("dependencies") or []
+    except OSError:
+        return []
+
+
+def _save_requirements(chart_dir: str, deps: list[dict]) -> None:
+    path = os.path.join(chart_dir, REQUIREMENTS_FILE)
+    if not deps:
+        if os.path.isfile(path):
+            os.unlink(path)
+        return
+    with open(path, "w", encoding="utf-8") as fh:
+        yaml.safe_dump({"dependencies": deps}, fh, sort_keys=False)
+
+
+def add_package(
+    chart_dir: str,
+    repo: str,
+    name: str,
+    version: Optional[str] = None,
+    logger: Optional[logutil.Logger] = None,
+) -> ChartEntry:
+    """Vendor a chart from ``repo`` under ``<chart_dir>/packages/<name>``
+    and record it in requirements.yaml. Package default values are merged
+    into the parent values.yaml under ``packages.<name>`` so users can see
+    and edit the knobs (reference appends README'd values the same way)."""
+    log = logger or logutil.get_logger()
+    if not os.path.isfile(os.path.join(chart_dir, "chart.yaml")):
+        raise PackageError(f"not a chart dir: {chart_dir}")
+    entry = resolve(repo, name, version)
+    dest = os.path.join(chart_dir, PACKAGES_DIR, name)
+    if os.path.isdir(dest):
+        raise PackageError(f"package '{name}' already added — remove it first")
+    _fetch_chart(repo, entry, dest)
+
+    deps = [d for d in load_requirements(chart_dir) if d.get("name") != name]
+    deps.append({"name": name, "version": entry.version, "repository": repo})
+    _save_requirements(chart_dir, deps)
+
+    # surface package defaults in the parent values.yaml
+    pkg_values_path = os.path.join(dest, "values.yaml")
+    parent_values_path = os.path.join(chart_dir, "values.yaml")
+    pkg_values = {}
+    if os.path.isfile(pkg_values_path):
+        with open(pkg_values_path, "r", encoding="utf-8") as fh:
+            pkg_values = yaml.safe_load(fh) or {}
+    parent_values = {}
+    if os.path.isfile(parent_values_path):
+        with open(parent_values_path, "r", encoding="utf-8") as fh:
+            parent_values = yaml.safe_load(fh) or {}
+    parent_values.setdefault("packages", {})[name] = pkg_values
+    with open(parent_values_path, "w", encoding="utf-8") as fh:
+        yaml.safe_dump(parent_values, fh, sort_keys=False)
+
+    log.done("[package] added %s %s from %s", name, entry.version, repo)
+    return entry
+
+
+def remove_package(
+    chart_dir: str, name: str, logger: Optional[logutil.Logger] = None
+) -> bool:
+    log = logger or logutil.get_logger()
+    dest = os.path.join(chart_dir, PACKAGES_DIR, name)
+    removed = False
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+        removed = True
+    deps = load_requirements(chart_dir)
+    kept = [d for d in deps if d.get("name") != name]
+    if len(kept) != len(deps):
+        removed = True
+    _save_requirements(chart_dir, kept)
+    parent_values_path = os.path.join(chart_dir, "values.yaml")
+    if os.path.isfile(parent_values_path):
+        with open(parent_values_path, "r", encoding="utf-8") as fh:
+            parent_values = yaml.safe_load(fh) or {}
+        if name in (parent_values.get("packages") or {}):
+            del parent_values["packages"][name]
+            if not parent_values["packages"]:
+                del parent_values["packages"]
+            with open(parent_values_path, "w", encoding="utf-8") as fh:
+                yaml.safe_dump(parent_values, fh, sort_keys=False)
+    if removed:
+        log.done("[package] removed %s", name)
+    else:
+        log.warn("[package] %s not found", name)
+    return removed
+
+
+def list_packages(chart_dir: str) -> list[dict]:
+    """Requirements + whether the vendored dir actually exists."""
+    out = []
+    for dep in load_requirements(chart_dir):
+        name = dep.get("name", "?")
+        out.append(
+            {
+                "name": name,
+                "version": dep.get("version", "?"),
+                "repository": dep.get("repository", "?"),
+                "vendored": os.path.isdir(os.path.join(chart_dir, PACKAGES_DIR, name)),
+            }
+        )
+    return out

@@ -1,0 +1,219 @@
+"""Package management tests: repo index, search, vendoring, subchart render.
+
+Reference behavior covered: configure/package.go (add merges dep into
+requirements + surfaces values), helm/search.go (repo search). Repos are
+local dirs and an in-process HTTP server serving .tgz archives — no egress.
+"""
+
+from __future__ import annotations
+
+import functools
+import http.server
+import io
+import os
+import tarfile
+import threading
+
+import pytest
+import yaml
+
+from devspace_tpu.deploy.chart import render_chart
+from devspace_tpu.deploy.packages import (
+    PackageError,
+    add_package,
+    list_packages,
+    load_requirements,
+    remove_package,
+    resolve,
+    search_charts,
+)
+
+REDIS_TEMPLATE = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: ${{ release.name }}-redis
+spec:
+  replicas: ${{ values.replicas }}
+  template:
+    spec:
+      containers:
+        - name: redis
+          image: redis:${{ values.tag }}
+"""
+
+
+def make_repo(root, with_v2: bool = False):
+    """A local chart repo with one 'redis' chart (optionally two versions)."""
+    chart = root / "charts" / "redis"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: redis\nversion: 1.0.0\n")
+    (chart / "values.yaml").write_text("replicas: 1\ntag: '7.0'\n")
+    (chart / "templates" / "deployment.yaml").write_text(REDIS_TEMPLATE)
+    entries = [{"version": "1.0.0", "description": "in-memory store", "path": "charts/redis"}]
+    if with_v2:
+        chart2 = root / "charts" / "redis-2"
+        (chart2 / "templates").mkdir(parents=True)
+        (chart2 / "chart.yaml").write_text("name: redis\nversion: 2.0.0\n")
+        (chart2 / "values.yaml").write_text("replicas: 2\ntag: '7.2'\n")
+        (chart2 / "templates" / "deployment.yaml").write_text(REDIS_TEMPLATE)
+        entries.insert(
+            0, {"version": "2.0.0", "description": "in-memory store", "path": "charts/redis-2"}
+        )
+    (root / "index.yaml").write_text(
+        yaml.safe_dump({"entries": {"redis": entries}})
+    )
+    return str(root)
+
+
+def make_parent_chart(root):
+    chart = root / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: app\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("port: 8080\n")
+    (chart / "templates" / "service.yaml").write_text(
+        "apiVersion: v1\nkind: Service\nmetadata:\n  name: ${{ release.name }}\n"
+        "spec:\n  ports:\n    - port: ${{ values.port }}\n"
+    )
+    return str(chart)
+
+
+def test_search_and_resolve(tmp_path):
+    repo = make_repo(tmp_path / "repo", with_v2=True)
+    hits = search_charts(repo, "memory")
+    assert [h.name for h in hits] == ["redis"]
+    assert hits[0].version == "2.0.0"  # newest wins
+    assert search_charts(repo, "nosuch") == []
+    assert resolve(repo, "redis").version == "2.0.0"
+    assert resolve(repo, "redis", "1.0.0").version == "1.0.0"
+    with pytest.raises(PackageError, match="no version 9"):
+        resolve(repo, "redis", "9")
+    with pytest.raises(PackageError, match="not found"):
+        resolve(repo, "postgres")
+
+
+def test_add_list_remove_package(tmp_path):
+    repo = make_repo(tmp_path / "repo")
+    chart_dir = make_parent_chart(tmp_path)
+
+    entry = add_package(chart_dir, repo, "redis")
+    assert entry.version == "1.0.0"
+    assert os.path.isfile(os.path.join(chart_dir, "packages", "redis", "chart.yaml"))
+    deps = load_requirements(chart_dir)
+    assert deps == [{"name": "redis", "version": "1.0.0", "repository": repo}]
+    # package defaults surfaced in parent values.yaml
+    values = yaml.safe_load(open(os.path.join(chart_dir, "values.yaml")))
+    assert values["packages"]["redis"]["replicas"] == 1
+
+    pkgs = list_packages(chart_dir)
+    assert pkgs[0]["name"] == "redis" and pkgs[0]["vendored"]
+
+    # double add refuses
+    with pytest.raises(PackageError, match="already added"):
+        add_package(chart_dir, repo, "redis")
+
+    assert remove_package(chart_dir, "redis")
+    assert not os.path.isdir(os.path.join(chart_dir, "packages", "redis"))
+    assert load_requirements(chart_dir) == []
+    values = yaml.safe_load(open(os.path.join(chart_dir, "values.yaml")))
+    assert "packages" not in values
+    assert not remove_package(chart_dir, "redis")  # idempotent
+
+
+def test_render_with_package(tmp_path):
+    repo = make_repo(tmp_path / "repo")
+    chart_dir = make_parent_chart(tmp_path)
+    add_package(chart_dir, repo, "redis")
+
+    # override a package value through the parent values.yaml namespace
+    values_path = os.path.join(chart_dir, "values.yaml")
+    values = yaml.safe_load(open(values_path))
+    values["packages"]["redis"]["replicas"] = 3
+    with open(values_path, "w") as fh:
+        yaml.safe_dump(values, fh)
+
+    manifests = render_chart(chart_dir, "myapp", "default")
+    kinds = {(m["kind"], m["metadata"]["name"]) for m in manifests}
+    assert ("Service", "myapp") in kinds
+    assert ("Deployment", "myapp-redis") in kinds
+    dep = next(m for m in manifests if m["kind"] == "Deployment")
+    assert dep["spec"]["replicas"] == 3  # parent override applied
+    image = dep["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image == "redis:7.0"  # package default kept
+    # both carry the release label
+    assert all(
+        m["metadata"]["labels"]["devspace.tpu/release"] == "myapp" for m in manifests
+    )
+
+
+def test_http_repo_with_archive(tmp_path):
+    """http(s) repos serve index.yaml + .tgz archives."""
+    src = tmp_path / "src"
+    make_repo(src)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        tf.add(str(src / "charts" / "redis"), arcname="redis")
+    webroot = tmp_path / "web"
+    webroot.mkdir()
+    (webroot / "redis-1.0.0.tgz").write_bytes(buf.getvalue())
+    (webroot / "index.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "entries": {
+                    "redis": [
+                        {
+                            "version": "1.0.0",
+                            "description": "in-memory store",
+                            "archive": "redis-1.0.0.tgz",
+                        }
+                    ]
+                }
+            }
+        )
+    )
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(webroot)
+    )
+    handler.log_message = lambda *a: None
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        repo = f"http://127.0.0.1:{server.server_address[1]}"
+        chart_dir = make_parent_chart(tmp_path)
+        entry = add_package(chart_dir, repo, "redis")
+        assert entry.version == "1.0.0"
+        assert os.path.isfile(
+            os.path.join(chart_dir, "packages", "redis", "chart.yaml")
+        )
+        manifests = render_chart(chart_dir, "app", "default")
+        assert len(manifests) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_cli_package_flow(tmp_path, monkeypatch):
+    from devspace_tpu.cli.main import main
+
+    repo = make_repo(tmp_path / "repo")
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    (proj / "train.py").write_text("import jax\n")
+    assert main(["init"]) == 0
+
+    assert main(["add", "package", "redis", "--repo", repo]) == 0
+    assert main(["list", "packages"]) == 0
+    assert main(["search", "redis", "--repo", repo]) == 0
+    # deploy renders the package alongside the app chart
+    assert main(["deploy"]) == 0
+    from devspace_tpu.kube.fake import FakeCluster
+
+    fc = FakeCluster(str(tmp_path / "cluster"), persist=True)
+    assert fc.get_object("apps/v1", "Deployment", "proj-redis", "default") is not None
+    assert main(["remove", "package", "redis"]) == 0
+    assert main(["add", "package", "ghost", "--repo", repo]) == 1
+    # no repo configured
+    assert main(["add", "package", "redis"]) == 1
