@@ -142,6 +142,20 @@ def repeat_kv(x, n_rep: int):
 
 
 def default_attention(q, k, v, causal: bool = True):
+    # [B, T, H, D] -> the fused kernels' [B, H, T, D] and back. On TPU this
+    # hits the simple fused kernel (short T) or flash (long T); elsewhere
+    # the jnp reference. Self-attention only (square T) — the KV-cache
+    # decode path keeps the einsum math below.
+    from ..ops.attention import fused_attention
+
+    if q.shape[1] == k.shape[1]:
+        out = fused_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=causal,
+        )
+        return out.transpose(0, 2, 1, 3)
     from ..parallel.ring_attention import full_attention
 
     return full_attention(q, k, v, causal=causal)

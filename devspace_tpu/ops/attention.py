@@ -129,8 +129,19 @@ def attention_pallas(q, k, v, causal: bool = True, block_q: int = 256):
     return _attention(q, k, v, causal, block_q)
 
 
+# Beyond this many keys, the simple kernel's full-row K/V residency stops
+# paying for itself and the online-softmax streaming kernel takes over.
+FLASH_THRESHOLD = 1024
+
+
 def fused_attention(q, k, v, causal: bool = True, block_q: int = 256):
-    """[B, H, T, D] attention; Pallas on TPU, reference elsewhere."""
+    """[B, H, T, D] attention; Pallas on TPU, reference elsewhere. Long
+    sequences stream through the flash kernel (flash_attention.py)."""
     if use_pallas() or interpret_mode():
+        t = q.shape[2]
+        if t > FLASH_THRESHOLD and t % 256 == 0:
+            from .flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal)
         return attention_pallas(q, k, v, causal=causal, block_q=block_q)
     return attention_reference(q, k, v, causal=causal)
