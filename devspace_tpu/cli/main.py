@@ -241,6 +241,26 @@ def cmd_status(args) -> int:
         log.print_table(
             ["DEPLOYMENT", "KIND", "NAME", "NAMESPACE", "STATUS"], rows
         )
+    elif args.what == "trace":
+        from ..utils import trace
+
+        spans = trace.load(os.path.join(ctx.root, ".devspace"))
+        if getattr(args, "export", None):
+            n = trace.export_chrome(
+                os.path.join(ctx.root, ".devspace"), args.export
+            )
+            log.done("[trace] wrote %d events to %s (chrome://tracing)", n, args.export)
+            return 0
+        rows = [
+            [
+                s.get("name", "?"),
+                f"{s.get('duration_s', 0)*1000:.0f}ms",
+                "ok" if s.get("ok") else s.get("error", "?")[:40],
+                s.get("parent") or "-",
+            ]
+            for s in spans[-30:]
+        ]
+        log.print_table(["SPAN", "DURATION", "RESULT", "PARENT"], rows)
     else:  # sync — scrape the sync log (reference: status/sync.go regexes)
         import json as _json
 
@@ -816,8 +836,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--all", action="store_true", help="also remove chart/ and Dockerfile")
     sp.set_defaults(fn=cmd_reset)
 
-    sp = sub.add_parser("status", help="deployment / sync status")
-    sp.add_argument("what", choices=["deployments", "sync"])
+    sp = sub.add_parser("status", help="deployment / sync / trace status")
+    sp.add_argument("what", choices=["deployments", "sync", "trace"])
+    sp.add_argument("--export", help="(trace) write chrome://tracing JSON here")
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("add", help="add config entries")
@@ -943,8 +964,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     root = find_root(os.getcwd())
     if root is not None:
         # Mirror everything into .devspace/logs/default.log (reference:
-        # log.StartFileLogging at the top of every command, cmd/dev.go:139).
+        # log.StartFileLogging at the top of every command, cmd/dev.go:139),
+        # and record phase spans (beyond-parity: SURVEY §5.1 notes the
+        # reference has no tracing).
         logutil.start_file_logging(os.path.join(root, ".devspace"))
+        from ..utils import trace
+
+        trace.enable(os.path.join(root, ".devspace"))
     try:
         return args.fn(args)
     except CLIError as e:

@@ -17,6 +17,7 @@ from ..deploy.manifests import deploy_all
 from ..services import sessions as svc
 from ..services.watch import GlobWatcher
 from ..utils import log as logutil
+from ..utils.trace import span
 from .context import Context
 
 
@@ -45,32 +46,37 @@ def build_and_deploy(
     log = logger or ctx.log
     config = ctx.config
     backend = ctx.backend
-    backend.ensure_namespace(ctx.namespace)
-    pull_secrets = init_registries(backend, config, ctx.namespace, log)
-    cache = ctx.loader.generated.get_cache(dev_mode)
-    image_tags = build_all(
-        config,
-        cache,
-        backend=backend,
-        dev_mode=dev_mode,
-        force=force_build,
-        base_dir=ctx.root,
-        logger=log,
-    )
-    ctx.save_generated()
-    inject_default_image(config, image_tags)
-    deploy_all(
-        backend,
-        config,
-        ctx.namespace,
-        image_tags=image_tags,
-        pull_secrets=pull_secrets,
-        force=force_deploy,
-        cache=cache,
-        base_dir=ctx.root,
-        logger=log,
-    )
-    ctx.save_generated()
+    with span("pipeline", dev_mode=dev_mode):
+        backend.ensure_namespace(ctx.namespace)
+        with span("registries"):
+            pull_secrets = init_registries(backend, config, ctx.namespace, log)
+        cache = ctx.loader.generated.get_cache(dev_mode)
+        with span("build", images=len(config.images or {})) as s:
+            image_tags = build_all(
+                config,
+                cache,
+                backend=backend,
+                dev_mode=dev_mode,
+                force=force_build,
+                base_dir=ctx.root,
+                logger=log,
+            )
+            s["built"] = len(image_tags)
+        ctx.save_generated()
+        inject_default_image(config, image_tags)
+        with span("deploy", deployments=len(config.deployments or [])):
+            deploy_all(
+                backend,
+                config,
+                ctx.namespace,
+                image_tags=image_tags,
+                pull_secrets=pull_secrets,
+                force=force_deploy,
+                cache=cache,
+                base_dir=ctx.root,
+                logger=log,
+            )
+        ctx.save_generated()
     return image_tags
 
 
@@ -95,15 +101,18 @@ class DevLoop:
         config = self.ctx.config
         backend = self.ctx.backend
         if not getattr(self.args, "no_portforwarding", False):
-            self.forwarders = svc.start_port_forwarding(backend, config, self.log)
+            with span("portforward.start"):
+                self.forwarders = svc.start_port_forwarding(backend, config, self.log)
         if not getattr(self.args, "no_sync", False):
-            self.sync_sessions = svc.start_sync(
-                backend,
-                config,
-                base_dir=self.ctx.root,
-                logger=self.log,
-                verbose=getattr(self.args, "verbose_sync", False),
-            )
+            with span("sync.start") as s:
+                self.sync_sessions = svc.start_sync(
+                    backend,
+                    config,
+                    base_dir=self.ctx.root,
+                    logger=self.log,
+                    verbose=getattr(self.args, "verbose_sync", False),
+                )
+                s["sessions"] = len(self.sync_sessions)
         auto_reload = (config.dev.auto_reload if config.dev else None)
         if auto_reload and not auto_reload.disabled and auto_reload.paths:
             self.watcher = GlobWatcher(

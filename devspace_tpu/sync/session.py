@@ -239,9 +239,17 @@ class SyncSession:
     def initial_sync(self) -> None:
         """Reconcile both sides, newest wins, no deletions
         (reference: sync_config.go initialSync/diffServerClient)."""
+        from ..utils.trace import span
+
+        with span("sync.initial", workers=len(self.workers)) as s:
+            self._initial_sync(s)
+
+    def _initial_sync(self, trace_span: dict) -> None:
         assert self._down_shell is not None
         remote = self._down_shell.snapshot(self._remote_dir(self.workers[0]))
         local = self._walk_local()
+        trace_span["local_files"] = len(local)
+        trace_span["remote_files"] = len(remote)
 
         uploads: list[FileInformation] = []
         downloads: list[str] = []

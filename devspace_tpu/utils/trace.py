@@ -1,0 +1,132 @@
+"""Span tracing for the dev loop — build/deploy/sync phases.
+
+The reference has NO tracing (SURVEY §5.1: "no pprof endpoints, no spans";
+closest is timestamped file logs). This subsystem is deliberately
+beyond-parity: every pipeline phase runs inside a span, spans nest, and
+the trace lands in ``.devspace/logs/trace.jsonl`` (one JSON object per
+span) plus an optional Chrome ``chrome://tracing`` export. Overhead is a
+clock read and one dict per span — nothing in the hot sync loops
+themselves, only around them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+_lock = threading.Lock()
+_trace_path: Optional[str] = None
+_spans: list[dict] = []  # in-memory ring (also used by `status trace`)
+_MAX_SPANS = 2000
+_tls = threading.local()
+
+
+def enable(devspace_dir: str) -> None:
+    """Start writing spans under ``<devspace_dir>/logs/trace.jsonl``."""
+    global _trace_path
+    logs = os.path.join(devspace_dir, "logs")
+    os.makedirs(logs, exist_ok=True)
+    _trace_path = os.path.join(logs, "trace.jsonl")
+
+
+def disable() -> None:
+    global _trace_path
+    _trace_path = None
+
+
+def _stack() -> list[str]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextmanager
+def span(name: str, **attrs: Any) -> Iterator[dict]:
+    """Time a phase. Nested spans record their parent; the yielded dict can
+    be updated with extra attributes mid-span."""
+    parent = _stack()[-1] if _stack() else None
+    _stack().append(name)
+    record: dict[str, Any] = {
+        "name": name,
+        "parent": parent,
+        "thread": threading.current_thread().name,
+        "start": time.time(),
+        **attrs,
+    }
+    t0 = time.perf_counter()
+    try:
+        yield record
+        record["ok"] = True
+    except BaseException as e:
+        record["ok"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _stack().pop()
+        record["duration_s"] = round(time.perf_counter() - t0, 6)
+        _emit(record)
+
+
+def _emit(record: dict) -> None:
+    with _lock:
+        _spans.append(record)
+        del _spans[:-_MAX_SPANS]
+        path = _trace_path
+    if path:
+        try:
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(record) + "\n")
+        except OSError:
+            pass
+
+
+def recent(limit: int = 50) -> list[dict]:
+    with _lock:
+        return list(_spans[-limit:])
+
+
+def load(devspace_dir: str) -> list[dict]:
+    """Read spans back from the trace file (newest last)."""
+    path = os.path.join(devspace_dir, "logs", "trace.jsonl")
+    out = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def export_chrome(devspace_dir: str, dest: str) -> int:
+    """Write a chrome://tracing / Perfetto-compatible trace. Returns the
+    number of events written."""
+    spans = load(devspace_dir)
+    events = []
+    for s in spans:
+        events.append(
+            {
+                "name": s.get("name", "?"),
+                "cat": "devspace",
+                "ph": "X",  # complete event
+                "ts": s.get("start", 0) * 1e6,
+                "dur": s.get("duration_s", 0) * 1e6,
+                "pid": 1,
+                "tid": s.get("thread", "main"),
+                "args": {
+                    k: v
+                    for k, v in s.items()
+                    if k not in ("name", "start", "duration_s", "thread")
+                },
+            }
+        )
+    with open(dest, "w", encoding="utf-8") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return len(events)

@@ -77,3 +77,40 @@ def test_print_table():
     lines = stream.getvalue().splitlines()
     assert lines[0].startswith("NAME") and "STATUS" in lines[0]
     assert "Running" in lines[1]
+
+
+def test_trace_spans(tmp_path):
+    """Span nesting, error capture, file sink, chrome export."""
+    from devspace_tpu.utils import trace
+
+    trace.enable(str(tmp_path))
+    try:
+        with trace.span("outer", phase="test") as s:
+            s["extra"] = 1
+            with trace.span("inner"):
+                pass
+        try:
+            with trace.span("failing"):
+                raise ValueError("boom")
+        except ValueError:
+            pass
+    finally:
+        trace.disable()
+
+    spans = trace.load(str(tmp_path))
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["extra"] == 1
+    assert by_name["outer"]["ok"] and by_name["inner"]["ok"]
+    assert not by_name["failing"]["ok"]
+    assert "boom" in by_name["failing"]["error"]
+    assert all(s["duration_s"] >= 0 for s in spans)
+
+    dest = tmp_path / "chrome.json"
+    n = trace.export_chrome(str(tmp_path), str(dest))
+    assert n == 3
+    import json
+
+    data = json.loads(dest.read_text())
+    assert {e["name"] for e in data["traceEvents"]} == {"outer", "inner", "failing"}
