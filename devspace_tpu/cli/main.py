@@ -766,11 +766,51 @@ def cmd_update(args) -> int:
 
 def cmd_upgrade(args) -> int:
     """Reference: cmd/upgrade.go — self-update via GitHub releases. This
-    build is distributed as a repo checkout; upgrading means git pull."""
-    logutil.get_logger().info(
-        "devspace-tpu %s — upgrade via 'git pull' in the framework checkout",
-        __version__,
-    )
+    build is distributed as a repo checkout; --apply runs git pull there."""
+    log = logutil.get_logger()
+    checkout = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if not getattr(args, "apply", False):
+        log.info(
+            "devspace-tpu %s — run 'devspace-tpu upgrade --apply' to git pull %s",
+            __version__,
+            checkout,
+        )
+        return 0
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", checkout, "pull", "--ff-only"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            check=True,
+        )
+        log.done("[upgrade] %s", (out.stdout or "").strip().splitlines()[-1])
+        return 0
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        log.error("[upgrade] git pull failed: %s", detail.strip())
+        return 1
+
+
+def cmd_install(args) -> int:
+    """Reference: cmd/install.go — put a `devspace-tpu` launcher on PATH."""
+    log = logutil.get_logger()
+    checkout = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    bin_dir = args.bin_dir or os.path.join(os.path.expanduser("~"), ".local", "bin")
+    os.makedirs(bin_dir, exist_ok=True)
+    launcher = os.path.join(bin_dir, "devspace-tpu")
+    with open(launcher, "w", encoding="utf-8") as fh:
+        fh.write(
+            "#!/bin/sh\n"
+            f'export PYTHONPATH="{checkout}${{PYTHONPATH:+:$PYTHONPATH}}"\n'
+            f'exec "{sys.executable}" -m devspace_tpu "$@"\n'
+        )
+    os.chmod(launcher, 0o755)
+    log.done("[install] wrote %s", launcher)
+    if bin_dir not in os.environ.get("PATH", "").split(os.pathsep):
+        log.warn("[install] %s is not on PATH — add it to your shell profile", bin_dir)
     return 0
 
 
@@ -948,8 +988,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("update", help="rewrite config at the latest schema")
     sp.set_defaults(fn=cmd_update)
 
-    sp = sub.add_parser("upgrade", help="show upgrade instructions")
+    sp = sub.add_parser("upgrade", help="upgrade the framework checkout")
+    sp.add_argument("--apply", action="store_true", help="run git pull")
     sp.set_defaults(fn=cmd_upgrade)
+
+    sp = sub.add_parser("install", help="install a devspace-tpu launcher on PATH")
+    sp.add_argument("--bin-dir", help="target dir (default ~/.local/bin)")
+    sp.set_defaults(fn=cmd_install)
 
     sp = sub.add_parser("print", help="print the resolved config")
     sp.set_defaults(fn=cmd_print_config)

@@ -156,3 +156,23 @@ def test_reset_removes_state(project):
     assert main(["reset", "--all"]) == 0
     assert not (project / ".devspace").exists()
     assert not (project / "chart").exists()
+
+
+def test_install_and_upgrade(tmp_path, monkeypatch):
+    import subprocess
+    import sys
+
+    from devspace_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    bin_dir = tmp_path / "bin"
+    assert main(["install", "--bin-dir", str(bin_dir)]) == 0
+    launcher = bin_dir / "devspace-tpu"
+    assert launcher.exists() and os.access(launcher, os.X_OK)
+    out = subprocess.run(
+        [str(launcher), "--version"], capture_output=True, text=True, timeout=60
+    )
+    assert out.returncode == 0
+
+    # upgrade without --apply just prints instructions
+    assert main(["upgrade"]) == 0
