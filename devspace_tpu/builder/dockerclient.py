@@ -219,6 +219,37 @@ def load_docker_auths(config_path: Optional[str] = None) -> dict[str, dict]:
     return out
 
 
+def save_docker_auth(
+    registry: str,
+    username: str,
+    password: str,
+    config_path: Optional[str] = None,
+) -> str:
+    """Persist a registry login into ~/.docker/config.json auths
+    (reference: pkg/devspace/docker/auth.go:34 Login with
+    ConfigFile.Save). Returns the path written."""
+    path = config_path or os.path.join(
+        os.environ.get("DOCKER_CONFIG", os.path.expanduser("~/.docker")),
+        "config.json",
+    )
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        data = {}
+    auths = data.setdefault("auths", {})
+    auths[registry] = {
+        "auth": base64.b64encode(f"{username}:{password}".encode()).decode()
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # credentials file: owner-only, like the docker CLI writes it
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+    os.chmod(path, 0o600)
+    return path
+
+
 def _auths_from_credstore(store: str) -> dict[str, dict]:
     """Query a docker credential helper (best effort)."""
     helper = f"docker-credential-{store}"

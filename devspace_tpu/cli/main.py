@@ -673,6 +673,62 @@ def cmd_remove_space(args) -> int:
     return 0
 
 
+def cmd_remove_context(args) -> int:
+    """Reference: cmd/remove/context.go — delete devspace-created kube
+    contexts (one space's, or --all). Purely local: --all scans the
+    kubeconfig for the devspace- prefix, so stale contexts of
+    already-deleted spaces are cleaned up too and no login is needed."""
+    from ..cloud.configure import kube_context_name, remove_kube_context
+    from ..kube.kubeconfig import KubeConfig
+
+    log = logutil.get_logger()
+    if args.all:
+        prefix = kube_context_name("")
+        names = [
+            c[len(prefix):]
+            for c in KubeConfig.load().contexts
+            if c.startswith(prefix)
+        ]
+        for name in names:
+            remove_kube_context(name)
+            log.done("[cloud] deleted kube context for space '%s'", name)
+        if not names:
+            log.info("no devspace kube contexts found")
+        return 0
+    if not args.name:
+        log.error("specify a space name or --all")
+        return 1
+    remove_kube_context(args.name)
+    log.done("[cloud] deleted kube context for space '%s'", args.name)
+    return 0
+
+
+def cmd_use_registry(args) -> int:
+    """Reference: cmd/use/registry.go — docker login into the provider's
+    registry with cloud credentials."""
+    from ..builder.dockerclient import save_docker_auth
+    from ..cloud.provider import CloudError
+
+    log = logutil.get_logger()
+    provider, _ = _provider(args)
+    try:
+        provider.ensure_logged_in()
+        auth = provider.get_registry_auth()
+    except CloudError as e:
+        log.error(str(e))
+        return 1
+    if not auth:
+        log.error("provider has no registry credentials")
+        return 1
+    registry = args.name or auth.get("registry")
+    if not registry:
+        log.error("provider did not name a registry; pass one explicitly")
+        return 1
+    save_docker_auth(registry, auth["username"], auth["password"])
+    log.done("[cloud] logged into registry %s", registry)
+    return 0
+
+
 def cmd_add_provider(args) -> int:
     """Reference: cmd/add/provider.go."""
     from ..cloud.config import CloudProvider, ProviderRegistry
@@ -764,11 +820,19 @@ def cmd_update(args) -> int:
     return 0
 
 
+def _checkout_root() -> str:
+    """Repo checkout containing the devspace_tpu package (cli/ -> package
+    -> checkout)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
 def cmd_upgrade(args) -> int:
     """Reference: cmd/upgrade.go — self-update via GitHub releases. This
     build is distributed as a repo checkout; --apply runs git pull there."""
     log = logutil.get_logger()
-    checkout = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    checkout = _checkout_root()
     if not getattr(args, "apply", False):
         log.info(
             "devspace-tpu %s — run 'devspace-tpu upgrade --apply' to git pull %s",
@@ -786,7 +850,8 @@ def cmd_upgrade(args) -> int:
             timeout=120,
             check=True,
         )
-        log.done("[upgrade] %s", (out.stdout or "").strip().splitlines()[-1])
+        lines = (out.stdout or "").strip().splitlines()
+        log.done("[upgrade] %s", lines[-1] if lines else "up to date")
         return 0
     except (OSError, subprocess.SubprocessError) as e:
         detail = getattr(e, "stderr", "") or str(e)
@@ -797,7 +862,7 @@ def cmd_upgrade(args) -> int:
 def cmd_install(args) -> int:
     """Reference: cmd/install.go — put a `devspace-tpu` launcher on PATH."""
     log = logutil.get_logger()
-    checkout = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    checkout = _checkout_root()
     bin_dir = args.bin_dir or os.path.join(os.path.expanduser("~"), ".local", "bin")
     os.makedirs(bin_dir, exist_ok=True)
     launcher = os.path.join(bin_dir, "devspace-tpu")
@@ -943,6 +1008,10 @@ def build_parser() -> argparse.ArgumentParser:
     q = rm_sub.add_parser("package", help="remove a vendored chart")
     q.add_argument("name")
     q.set_defaults(fn=cmd_remove_package)
+    q = rm_sub.add_parser("context", help="remove a space's kube context")
+    q.add_argument("name", nargs="?")
+    q.add_argument("--all", action="store_true")
+    q.set_defaults(fn=cmd_remove_context)
 
     sp = sub.add_parser("list", help="list config entries")
     sp.add_argument(
@@ -969,6 +1038,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("name")
     q.add_argument("--provider")
     q.set_defaults(fn=cmd_use_space)
+    q = use_sub.add_parser("registry", help="docker login via cloud creds")
+    q.add_argument("name", nargs="?")
+    q.add_argument("--provider")
+    q.set_defaults(fn=cmd_use_registry)
     sp.set_defaults(fn=cmd_use)
 
     sp = sub.add_parser("login", help="log in to a cloud provider")

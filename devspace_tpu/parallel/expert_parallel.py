@@ -1,0 +1,221 @@
+"""Expert parallelism: Mixture-of-Experts with all-to-all dispatch.
+
+GShard/Switch-style MoE laid out TPU-first: experts are sharded over a
+mesh axis (conventionally the ``data`` axis — ep-over-dp, the standard
+TPU recipe), tokens stay batch-sharded on the same axis, and routing
+moves tokens to their expert's device with a pair of ``jax.lax.all_to_all``
+collectives that ride ICI. Inside each device the expert FFNs run as one
+batched einsum over the local expert dim, keeping the MXU busy with a
+single large contraction instead of E small ones.
+
+Routing is capacity-based top-k (k=1 -> Switch, k=2 -> GShard): each
+expert accepts at most ``capacity`` tokens per device per step; overflow
+tokens fall through the residual connection (their combine weight is
+zero). Static shapes throughout — capacity is computed from the static
+token count, so the whole layer is jit/scan-friendly.
+
+The reference (hoatle/devspace) contains no ML parallelism at all
+(SURVEY.md §2.13); this module is part of the TPU-native framework's
+first-class parallelism layer alongside data/tensor/pipeline/sequence.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(
+    key,
+    dim: int,
+    ffn_dim: int,
+    num_experts: int,
+    dtype=jnp.bfloat16,
+    scale: float = 0.02,
+) -> dict:
+    """Pytree params: router ``w_gate`` [D, E] (kept float32 — routing
+    logits are precision-sensitive) and stacked expert FFNs ``w_up``
+    [E, D, F], ``w_down`` [E, F, D]."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w_gate": jax.random.normal(k1, (dim, num_experts), jnp.float32) * scale,
+        "w_up": (
+            jax.random.normal(k2, (num_experts, dim, ffn_dim), jnp.float32) * scale
+        ).astype(dtype),
+        "w_down": (
+            jax.random.normal(k3, (num_experts, ffn_dim, dim), jnp.float32) * scale
+        ).astype(dtype),
+    }
+
+
+def moe_param_spec(axis: str = "data") -> dict:
+    """PartitionSpec tree matching ``init_moe_params``: experts sharded
+    over ``axis``, router replicated."""
+    return {
+        "w_gate": P(),
+        "w_up": P(axis, None, None),
+        "w_down": P(axis, None, None),
+    }
+
+
+def shard_moe_params(params: dict, mesh: Mesh, axis: str = "data") -> dict:
+    return jax.tree.map(
+        lambda w, spec: jax.device_put(w, NamedSharding(mesh, spec)),
+        params,
+        moe_param_spec(axis),
+    )
+
+
+def expert_capacity(
+    tokens_per_device: int, num_experts: int, capacity_factor: float, k: int
+) -> int:
+    """Per-expert, per-source-device slot count (static)."""
+    return max(1, math.ceil(capacity_factor * k * tokens_per_device / num_experts))
+
+
+def _route(probs, k: int, capacity: int):
+    """Capacity-based top-k routing (all static shapes).
+
+    probs: [T, E] router probabilities. Returns (dispatch [T, E, C] 0/1,
+    combine [T, E, C] floats, aux_loss scalar). Tokens beyond an expert's
+    capacity are dropped (combine row = 0 -> residual passthrough).
+    """
+    T, E = probs.shape
+    remaining = probs
+    counts = jnp.zeros((E,), jnp.int32)  # slots used per expert so far
+    dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
+    gates = []  # per-choice kept gate values [T]
+    onehots = []  # per-choice expert one-hot [T, E]
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [T, E]
+        gate = jnp.sum(remaining * onehot, axis=-1)  # [T]
+        # position of each token within its chosen expert's queue:
+        # tokens earlier in the batch (and earlier choices) get priority.
+        pos_matrix = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :].astype(
+            probs.dtype
+        )
+        pos = jnp.sum(pos_matrix * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = pos < capacity
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos, capacity), capacity, dtype=jnp.float32
+        )  # [T, C] (overflow rows all-zero)
+        dispatch = dispatch | (
+            (onehot[:, :, None] * slot[:, None, :]) > 0.5
+        )
+        counts = counts + jnp.sum(
+            onehot * keep[:, None].astype(probs.dtype), axis=0
+        ).astype(jnp.int32)
+        gates.append(jnp.where(keep, gate, 0.0))
+        onehots.append(onehot)
+        remaining = remaining * (1.0 - onehot)
+    # normalize kept gates across choices (GShard top-2 normalization)
+    gate_stack = jnp.stack(gates, axis=0)  # [k, T]
+    denom = jnp.sum(gate_stack, axis=0, keepdims=True)
+    gate_stack = gate_stack / jnp.maximum(denom, 1e-9)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    for c in range(k):
+        choice_disp = (
+            onehots[c][:, :, None] * dispatch.astype(probs.dtype)
+        )  # this choice's slots
+        combine = combine + gate_stack[c][:, None, None] * choice_disp
+    # Switch load-balancing aux loss on the primary assignment:
+    # E * sum_e fraction_dispatched_e * mean_prob_e (1.0 when balanced).
+    frac = jnp.mean(onehots[0], axis=0)  # [E]
+    mean_prob = jnp.mean(probs, axis=0)  # [E]
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    mesh: Mesh,
+    axis: str = "data",
+    k: int = 1,
+    capacity_factor: float = 1.25,
+    activation: Callable = jax.nn.gelu,
+):
+    """Build the expert-parallel MoE FFN.
+
+    Returns ``f(x, params) -> (y, aux_loss)`` where x is [T, D] with T
+    sharded over ``axis`` and params as ``init_moe_params`` sharded per
+    ``moe_param_spec`` (E over ``axis``). Per shard:
+
+      route -> dispatch einsum -> all_to_all (tokens to their expert's
+      device) -> batched expert FFN -> all_to_all back -> combine einsum
+
+    aux_loss is the Switch load-balancing loss, psum-averaged over the
+    axis; add ``aux_weight * aux_loss`` (typically 1e-2) to the train loss.
+    """
+    n_shards = mesh.shape[axis]
+
+    def local_fn(x, params):
+        T, D = x.shape  # local tokens
+        E = params["w_gate"].shape[1]  # global expert count
+        assert E % n_shards == 0, f"experts {E} not divisible by axis {n_shards}"
+        capacity = expert_capacity(T, E, capacity_factor, k)
+        logits = jnp.einsum(
+            "td,de->te", x.astype(jnp.float32), params["w_gate"]
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, aux = _route(probs, k, capacity)
+        # [T, E, C] x [T, D] -> [E, C, D]: gather each expert's tokens
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(x.dtype), x
+        )
+        # tokens to their expert's device: split E, concat C.
+        # [E, C, D] -> [E/n, n*C, D]; dim 1 is now (source_shard, slot).
+        expert_in = jax.lax.all_to_all(
+            expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        w_up, w_down = params["w_up"], params["w_down"]  # local [E/n, D, F]
+        h = activation(
+            jnp.einsum(
+                "ecd,edf->ecf", expert_in, w_up,
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(x.dtype)
+        expert_out = jnp.einsum(
+            "ecf,efd->ecd", h, w_down, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        # route results back to the tokens' home devices
+        expert_out = jax.lax.all_to_all(
+            expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+        )
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(x.dtype), expert_out
+        )
+        return y, jax.lax.pmean(aux, axis)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis, None), moe_param_spec(axis)),
+        out_specs=(P(axis, None), P()),
+        check_vma=False,
+    )
+
+
+def moe_ffn_reference(x, params, k: int = 1, capacity_factor: float = 1.25,
+                      activation: Callable = jax.nn.gelu):
+    """Single-device reference semantics (no mesh) for testing: identical
+    routing and capacity rules, experts applied densely."""
+    T, D = x.shape
+    E = params["w_gate"].shape[1]
+    capacity = expert_capacity(T, E, capacity_factor, k)
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), params["w_gate"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _route(probs, k, capacity)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    h = activation(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"],
+                   preferred_element_type=jnp.float32)
+    ).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, params["w_down"], preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return y, aux

@@ -297,6 +297,44 @@ def test_registry_auth(cloud_env):
     assert auth == {"registry": "registry.test", "username": "sa", "password": "pw"}
 
 
+def test_cli_use_registry_and_remove_context(cloud_env, tmp_path, monkeypatch):
+    """use registry writes docker auth; remove context [--all] drops
+    devspace-created kube contexts (reference: cmd/use/registry.go,
+    cmd/remove/context.go)."""
+    from devspace_tpu.cli.main import main
+
+    proj = tmp_path / "proj2"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    docker_dir = tmp_path / "dockercfg"
+    monkeypatch.setenv("DOCKER_CONFIG", str(docker_dir))
+
+    assert main(["login", "--key", VALID_KEY, "--provider", "test"]) == 0
+    assert main(["use", "registry", "--provider", "test"]) == 0
+    cfg = json.loads((docker_dir / "config.json").read_text())
+    auth = base64.b64decode(cfg["auths"]["registry.test"]["auth"]).decode()
+    assert auth == "sa:pw"
+    # explicit registry name wins over the provider's default
+    assert main(["use", "registry", "alt.registry.test", "--provider", "test"]) == 0
+    cfg = json.loads((docker_dir / "config.json").read_text())
+    assert "alt.registry.test" in cfg["auths"]
+
+    assert main(["create", "space", "ctx1", "--provider", "test"]) == 0
+    assert main(["create", "space", "ctx2", "--provider", "test"]) == 0
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert "devspace-ctx1" in kc.contexts and "devspace-ctx2" in kc.contexts
+    assert main(["remove", "context", "ctx1"]) == 0
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert "devspace-ctx1" not in kc.contexts
+    assert "devspace-ctx2" in kc.contexts
+    # --all is purely local (kubeconfig prefix scan): no provider needed
+    assert main(["remove", "context", "--all"]) == 0
+    kc = KubeConfig.load(cloud_env["kube_path"])
+    assert "devspace-ctx2" not in kc.contexts
+    assert main(["remove", "context"]) == 1  # no name, no --all
+
+
 def test_cli_cloud_flow(cloud_env, tmp_path, monkeypatch):
     """login --key -> create space -> list spaces -> remove space via CLI."""
     from devspace_tpu.cli.main import main
