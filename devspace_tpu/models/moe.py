@@ -1,0 +1,190 @@
+"""Mixtral-style Mixture-of-Experts decoder-only transformer.
+
+Same attention stack as ``models.transformer`` (RoPE, GQA, RMSNorm) with
+the dense SwiGLU FFN replaced by a routed expert layer: a top-k router
+picks ``experts_per_token`` of ``num_experts`` SwiGLU experts per token.
+Expert weights are stored stacked ([E, D, 2F] / [E, F, D]) so the expert
+compute is one batched einsum on the MXU, and the gate+up projections are
+fused into a single [E, D, 2F] tensor (``parallel.expert_parallel.swiglu``
+splits them after the matmul).
+
+Parallel layouts:
+- dense (default): every device computes all experts — fine for tests and
+  single-chip inference of small models;
+- expert-parallel: pass ``moe_fn=moe_ffn(mesh, axis=..., k=...,
+  activation=swiglu)`` — experts shard over the axis and tokens move by
+  all-to-all (see parallel/expert_parallel.py);
+- tensor-parallel attention composes unchanged via ``attention_fn``.
+
+The reference (hoatle/devspace) ships no model code (SURVEY.md §5.7); the
+model families live in the framework the way the reference keeps app-level
+concerns in its scaffolded examples.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.expert_parallel import moe_ffn_reference, moe_param_spec, swiglu
+from .transformer import (
+    apply_rope,
+    default_attention,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 2.0
+    aux_weight: float = 1e-2
+    max_seq_len: int = 32768
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+MIXTRAL_8X7B = MoEConfig()
+TINY_MOE = MoEConfig(
+    vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+    num_experts=4, experts_per_token=2, max_seq_len=128,
+)
+
+
+def init_params(cfg: MoEConfig, key) -> dict:
+    """Pytree: {embed, layers: [{wq,wk,wv,wo,attn_norm,ffn_norm,
+    moe: {w_gate [D,E] f32 router, w_up [E,D,2F], w_down [E,F,D]}}],
+    final_norm, lm_head}."""
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    scale = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    hd = cfg.head_dim
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i], 7)
+        layers.append(
+            {
+                "wq": dense(lk[0], (cfg.dim, cfg.n_heads * hd)),
+                "wk": dense(lk[1], (cfg.dim, cfg.n_kv_heads * hd)),
+                "wv": dense(lk[2], (cfg.dim, cfg.n_kv_heads * hd)),
+                "wo": dense(lk[3], (cfg.n_heads * hd, cfg.dim)),
+                "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+                "ffn_norm": jnp.ones((cfg.dim,), jnp.float32),
+                "moe": {
+                    "w_gate": jax.random.normal(
+                        lk[4], (cfg.dim, cfg.num_experts), jnp.float32
+                    )
+                    * scale,
+                    "w_up": dense(
+                        lk[5], (cfg.num_experts, cfg.dim, 2 * cfg.ffn_dim)
+                    ),
+                    "w_down": dense(
+                        lk[6], (cfg.num_experts, cfg.ffn_dim, cfg.dim)
+                    ),
+                },
+            }
+        )
+    return {
+        "embed": dense(keys[-2], (cfg.vocab_size, cfg.dim)),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(keys[-1], (cfg.dim, cfg.vocab_size)),
+    }
+
+
+def param_partition_spec(
+    cfg: MoEConfig,
+    model_axis: Optional[str] = "model",
+    expert_axis: Optional[str] = "data",
+) -> dict:
+    """Attention tensor-parallel over ``model_axis``; experts sharded over
+    ``expert_axis`` (ep-over-dp; pass None to replicate either)."""
+    layer = {
+        "wq": P(None, model_axis),
+        "wk": P(None, model_axis),
+        "wv": P(None, model_axis),
+        "wo": P(model_axis, None),
+        "attn_norm": P(),
+        "ffn_norm": P(),
+        "moe": moe_param_spec(expert_axis),
+    }
+    return {
+        "embed": P(),
+        "layers": [dict(layer, moe=dict(layer["moe"])) for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(None, model_axis),
+    }
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    cfg: MoEConfig,
+    attention_fn: Optional[Callable] = None,
+    moe_fn: Optional[Callable] = None,
+    positions: Optional[jax.Array] = None,
+):
+    """-> (logits [B, T, vocab] float32, aux_loss scalar).
+
+    ``moe_fn(x2d, moe_params) -> (y2d, aux)`` operates on flattened
+    [B*T, D] tokens; defaults to the dense single-device routing. For
+    expert parallelism pass ``parallel.expert_parallel.moe_ffn(mesh,
+    axis=..., k=cfg.experts_per_token, activation=swiglu)``. aux_loss is
+    the mean load-balancing loss over layers — add ``cfg.aux_weight *
+    aux`` to the train loss."""
+    attn = attention_fn or (lambda q, k, v: default_attention(q, k, v, causal=True))
+    if moe_fn is None:
+        def moe_fn(x2d, moe_params):
+            return moe_ffn_reference(
+                x2d,
+                moe_params,
+                k=cfg.experts_per_token,
+                capacity_factor=cfg.capacity_factor,
+                activation=swiglu,
+            )
+
+    b, t = tokens.shape
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    if positions is None:
+        positions = jnp.arange(t)
+    cos, sin = rope_frequencies(cfg, positions)
+    h = params["embed"][tokens]
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
+        k = (x @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+        v = (x @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ctx = attn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
+        h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
+        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+        y2d, aux = moe_fn(x.reshape(b * t, cfg.dim), layer["moe"])
+        h = h + y2d.reshape(b, t, cfg.dim).astype(h.dtype)
+        aux_total = aux_total + aux
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers

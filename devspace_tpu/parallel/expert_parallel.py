@@ -29,6 +29,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def swiglu(h):
+    """SwiGLU expert activation for fused gate+up projections: ``h``
+    [..., 2F] (gate | up concatenated on the last dim) -> [..., F].
+    Lets Mixtral-style experts ride the same single batched einsum as
+    plain-MLP experts."""
+    f = h.shape[-1] // 2
+    return jax.nn.silu(h[..., :f]) * h[..., f:]
+
+
 def init_moe_params(
     key,
     dim: int,

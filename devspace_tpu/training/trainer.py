@@ -79,6 +79,36 @@ def make_classifier_train_step(
     )
 
 
+def _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate):
+    """Shared jit wrapper for LM train steps: replicated or TP/EP-sharded
+    state, batch over the data axis, donated input state."""
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    repl = NamedSharding(mesh, P())
+    if param_spec is None:
+        state_sharding = repl
+    else:
+        # opt_state stays replicated here; for adam-scale optimizers shard
+        # it like the params at init time (its mu/nu mirror param shapes).
+        state_sharding = {
+            "params": jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                param_spec,
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+            "opt_state": repl,
+            "step": repl,
+        }
+    batch_shard = NamedSharding(mesh, P(data_axis))
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_sharding, batch_shard),
+        out_shardings=(state_sharding, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def make_lm_train_step(
     forward: Callable,
     cfg,
@@ -114,34 +144,51 @@ def make_lm_train_step(
             "step": state["step"] + 1,
         }, loss
 
-    if mesh is None:
-        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    return _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate)
 
-    def to_sharding(spec_tree):
-        return jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s),
-            spec_tree,
-            is_leaf=lambda s: isinstance(s, P),
+
+def make_moe_lm_train_step(
+    forward: Callable,
+    cfg,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    data_axis: str = "data",
+    param_spec=None,
+    attention_fn=None,
+    moe_fn=None,
+    donate: bool = True,
+):
+    """Causal-LM train step for the MoE transformer (models.moe): loss =
+    next-token cross-entropy + cfg.aux_weight * load-balancing aux.
+    ``moe_fn`` injects the expert-parallel layer (expert_parallel.moe_ffn);
+    None keeps the dense routing."""
+
+    def loss_fn(params, tokens):
+        logits, aux = forward(
+            params, tokens[:, :-1], cfg, attention_fn=attention_fn, moe_fn=moe_fn
         )
+        b, t, v = logits.shape
+        ce = jnp.mean(
+            fused_cross_entropy(logits.reshape(b * t, v), tokens[:, 1:].reshape(-1))
+        )
+        return ce + cfg.aux_weight * aux, (ce, aux)
 
-    repl = NamedSharding(mesh, P())
-    if param_spec is None:
-        state_sharding = repl
-    else:
-        # opt_state stays replicated here; for adam-scale optimizers shard
-        # it like the params at init time (its mu/nu mirror param shapes).
-        state_sharding = {
-            "params": to_sharding(param_spec),
-            "opt_state": repl,
-            "step": repl,
-        }
-    batch_shard = NamedSharding(mesh, P(data_axis))
-    return jax.jit(
-        step_fn,
-        in_shardings=(state_sharding, batch_shard),
-        out_shardings=(state_sharding, repl),
-        donate_argnums=(0,) if donate else (),
-    )
+    def step_fn(state, tokens):
+        (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], tokens
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            **state,
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, {"loss": loss, "ce": ce, "aux": aux}
+
+    return _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate)
 
 
 def accumulate_gradients(loss_fn: Callable, n_accum: int) -> Callable:
