@@ -1,0 +1,65 @@
+"""Regenerate docs/cli.md from the live argparse tree (run from repo root:
+python docs/gen_cli_reference.py). Keeps the CLI reference from drifting."""
+
+import argparse
+import io
+import sys
+
+sys.path.insert(0, ".")
+from devspace_tpu.cli.main import build_parser  # noqa: E402
+
+
+def subparsers_of(parser):
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            # dedupe aliases: choices maps name -> parser
+            seen = {}
+            for name, sub in action.choices.items():
+                seen.setdefault(id(sub), (name, sub))
+            return sorted(seen.values(), key=lambda kv: kv[0])
+    return []
+
+
+def options_of(parser):
+    rows = []
+    for action in parser._actions:
+        if isinstance(action, (argparse._HelpAction, argparse._SubParsersAction)):
+            continue
+        if action.option_strings:
+            name = ", ".join(action.option_strings)
+        else:
+            name = f"<{action.dest}>" + ("" if action.nargs != "?" else " (optional)")
+        rows.append((name, action.help or ""))
+    return rows
+
+
+def emit(parser, name, out, depth):
+    out.write(f"\n{'#' * depth} `{name}`\n\n")
+    if parser.description:
+        out.write(parser.description.strip() + "\n\n")
+    rows = options_of(parser)
+    if rows:
+        out.write("| argument | description |\n|---|---|\n")
+        for arg, help_ in rows:
+            out.write(f"| `{arg}` | {help_} |\n")
+        out.write("\n")
+    for sub_name, sub in subparsers_of(parser):
+        emit(sub, f"{name} {sub_name}", out, min(depth + 1, 4))
+
+
+def main():
+    parser = build_parser()
+    out = io.StringIO()
+    out.write(
+        "# CLI reference\n\n"
+        "Generated from the argparse tree by `docs/gen_cli_reference.py` —\n"
+        "do not edit by hand; regenerate after changing commands.\n"
+    )
+    emit(parser, "devspace-tpu", out, 2)
+    with open("docs/cli.md", "w", encoding="utf-8") as fh:
+        fh.write(out.getvalue())
+    print("wrote docs/cli.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Docs health: internal links resolve and the generated CLI reference is
+in sync with the argparse tree (regeneration is part of changing the CLI)."""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs")
+
+LINK_RE = re.compile(r"\[[^\]]*\]\(([^)]+)\)")
+
+
+def test_docs_internal_links_resolve():
+    broken = []
+    for fname in os.listdir(DOCS):
+        if not fname.endswith(".md"):
+            continue
+        text = open(os.path.join(DOCS, fname), encoding="utf-8").read()
+        for target in LINK_RE.findall(text):
+            if target.startswith(("http://", "https://", "#", "mailto:")):
+                continue
+            path = os.path.normpath(os.path.join(DOCS, target.split("#")[0]))
+            if not os.path.exists(path):
+                broken.append(f"{fname}: {target}")
+    assert not broken, f"broken doc links: {broken}"
+
+
+def test_readme_links_resolve():
+    text = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
+    for target in LINK_RE.findall(text):
+        if target.startswith(("http://", "https://", "#")):
+            continue
+        path = os.path.normpath(os.path.join(REPO, target.split("#")[0]))
+        assert os.path.exists(path), f"README.md: broken link {target}"
+
+
+def test_cli_reference_up_to_date(tmp_path):
+    """docs/cli.md must match what the generator produces right now."""
+    current = open(os.path.join(DOCS, "cli.md"), encoding="utf-8").read()
+    out = subprocess.run(
+        [sys.executable, os.path.join(DOCS, "gen_cli_reference.py")],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    regenerated = open(os.path.join(DOCS, "cli.md"), encoding="utf-8").read()
+    if regenerated != current:
+        # restore so a failing test doesn't dirty the tree
+        with open(os.path.join(DOCS, "cli.md"), "w", encoding="utf-8") as fh:
+            fh.write(current)
+        pytest.fail("docs/cli.md is stale — run python docs/gen_cli_reference.py")
