@@ -24,7 +24,15 @@ def log(msg: str) -> None:
 
 
 def bench_resnet50() -> tuple[float, str]:
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The image's sitecustomize pre-imports jax and freezes the
+        # platform default at interpreter startup — the env var alone is
+        # too late (same workaround as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -118,7 +126,74 @@ def bench_sync_latency() -> float:
     return lat[len(lat) // 2]
 
 
+def run_resnet_isolated() -> tuple[float, str]:
+    """Run the ResNet bench in a child process with a hard timeout, falling
+    back to CPU when the accelerator is unreachable. Protects against a
+    wedged device tunnel: jax device init can hang indefinitely, and a
+    bench that never prints its JSON line records nothing at all."""
+    import os
+    import subprocess
+
+    def child(env_extra: dict, timeout: float) -> tuple[float, str] | None:
+        env = dict(os.environ, **env_extra)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--resnet-child"],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"[bench] resnet child timed out after {timeout:.0f}s")
+            return None
+        for line in out.stderr.splitlines():
+            log(line)
+        for line in out.stdout.splitlines():
+            if line.startswith("RESNET_RESULT "):
+                _, value, platform = line.split()
+                return float(value), platform
+        log(f"[bench] resnet child failed (rc={out.returncode})")
+        return None
+
+    # Unset JAX_PLATFORMS counts as accelerator-possible: on a TPU host the
+    # chip is the default platform, and the probe is cheap on plain CPU.
+    on_accelerator = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    healthy = True
+    if on_accelerator:
+        # Cheap health probe first: a wedged tunnel hangs device init, so
+        # don't spend the full bench timeout discovering that.
+        try:
+            probe = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; import jax.numpy as jnp;"
+                    "x = jnp.ones((256, 256), jnp.bfloat16);"
+                    "(x @ x).block_until_ready();"
+                    "print('PROBE_OK', jax.devices()[0].platform)",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=180.0,
+            )
+            healthy = "PROBE_OK" in probe.stdout
+        except subprocess.TimeoutExpired:
+            healthy = False
+        if not healthy:
+            log("[bench] accelerator probe failed")
+    result = child({}, timeout=1200.0) if healthy else None
+    if result is None and on_accelerator:
+        log("[bench] accelerator unusable — falling back to CPU numbers")
+        result = child({"JAX_PLATFORMS": "cpu"}, timeout=600.0)
+    return result or (0.0, "none")
+
+
 def main() -> int:
+    if "--resnet-child" in sys.argv:
+        imgs_per_sec, platform = bench_resnet50()
+        print(f"RESNET_RESULT {imgs_per_sec} {platform}", flush=True)
+        return 0
     sync_latency = None
     try:
         sync_latency = bench_sync_latency()
@@ -126,7 +201,7 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"[bench] sync latency bench failed: {e}")
     try:
-        imgs_per_sec, platform = bench_resnet50()
+        imgs_per_sec, platform = run_resnet_isolated()
     except Exception as e:  # noqa: BLE001
         log(f"[bench] resnet bench failed: {e}")
         imgs_per_sec, platform = 0.0, "none"

@@ -20,6 +20,11 @@ def subparsers_of(parser):
     return []
 
 
+def _cell(text):
+    """Escape a value for a markdown table cell."""
+    return text.replace("|", "\\|").replace("\n", " ")
+
+
 def options_of(parser):
     rows = []
     for action in parser._actions:
@@ -29,7 +34,7 @@ def options_of(parser):
             name = ", ".join(action.option_strings)
         else:
             name = f"<{action.dest}>" + ("" if action.nargs != "?" else " (optional)")
-        rows.append((name, action.help or ""))
+        rows.append((_cell(name), _cell(action.help or "")))
     return rows
 
 
@@ -48,6 +53,7 @@ def emit(parser, name, out, depth):
 
 
 def main():
+    target = sys.argv[1] if len(sys.argv) > 1 else "docs/cli.md"
     parser = build_parser()
     out = io.StringIO()
     out.write(
@@ -56,9 +62,9 @@ def main():
         "do not edit by hand; regenerate after changing commands.\n"
     )
     emit(parser, "devspace-tpu", out, 2)
-    with open("docs/cli.md", "w", encoding="utf-8") as fh:
+    with open(target, "w", encoding="utf-8") as fh:
         fh.write(out.getvalue())
-    print("wrote docs/cli.md")
+    print(f"wrote {target}")
 
 
 if __name__ == "__main__":

@@ -41,19 +41,18 @@ def test_readme_links_resolve():
 
 
 def test_cli_reference_up_to_date(tmp_path):
-    """docs/cli.md must match what the generator produces right now."""
+    """docs/cli.md must match what the generator produces right now
+    (generated to a temp path — the checked-in file is never touched)."""
     current = open(os.path.join(DOCS, "cli.md"), encoding="utf-8").read()
+    target = tmp_path / "cli.md"
     out = subprocess.run(
-        [sys.executable, os.path.join(DOCS, "gen_cli_reference.py")],
+        [sys.executable, os.path.join(DOCS, "gen_cli_reference.py"), str(target)],
         cwd=REPO,
         capture_output=True,
         text=True,
         timeout=120,
     )
     assert out.returncode == 0, out.stderr
-    regenerated = open(os.path.join(DOCS, "cli.md"), encoding="utf-8").read()
+    regenerated = target.read_text(encoding="utf-8")
     if regenerated != current:
-        # restore so a failing test doesn't dirty the tree
-        with open(os.path.join(DOCS, "cli.md"), "w", encoding="utf-8") as fh:
-            fh.write(current)
         pytest.fail("docs/cli.md is stale — run python docs/gen_cli_reference.py")
