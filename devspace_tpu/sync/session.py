@@ -177,6 +177,10 @@ class SyncSession:
         self.stats = {"uploaded": 0, "downloaded": 0, "removed_local": 0, "removed_remote": 0}
         self.started_at: Optional[float] = None
         self.initial_sync_done = threading.Event()
+        # Partial-failure state (SURVEY §7 hard part #2): workers dropped
+        # from the fan-out after an unrecoverable error, index -> reason.
+        self.worker_errors: dict[int, str] = {}
+        self._workers_lock = threading.Lock()
 
     # -- paths -------------------------------------------------------------
     def _remote_dir(self, worker) -> str:
@@ -428,10 +432,108 @@ class SyncSession:
             return False
         return remote.mtime > idx.mtime
 
+    # -- partial failure (SURVEY §7 hard part #2) ---------------------------
+    def _live_indices(self) -> list[int]:
+        with self._workers_lock:
+            return [
+                i for i in range(len(self.workers)) if i not in self.worker_errors
+            ]
+
+    def _mark_worker_failed(self, i: int, exc: BaseException) -> None:
+        with self._workers_lock:
+            if i in self.worker_errors:
+                return
+            self.worker_errors[i] = str(exc)
+        try:
+            self._shells[i].close()
+        except Exception:  # noqa: BLE001 — already broken
+            pass
+        self.log.error(
+            "[sync] worker %s dropped from fan-out: %s",
+            getattr(self.workers[i], "name", i),
+            exc,
+        )
+
+    def _try_revive(self, i: int) -> bool:
+        """Reopen the worker's shell and catch its tree up to the index —
+        handles a container restart (exec dies, pod comes back). Presence
+        parity only: files deleted while the worker was dead are cleaned
+        up by the next remove that targets them."""
+        worker = self.workers[i]
+        try:
+            proc = self.backend.exec_stream(
+                worker, ["sh"], container=self.opts.container, tty=False
+            )
+            shell = RemoteShell(proc, label=f"up{getattr(worker, 'name', i)}")
+            snap = shell.snapshot(self._remote_dir(worker))
+            need = [
+                info
+                for rel, info in self.index.snapshot().items()
+                if rel not in snap
+                or (not info.is_directory and not info.same_as(snap[rel]))
+            ]
+            if need:
+                for batch in _batch_entries(need):
+                    tar_bytes = build_tar(self.opts.local_path, batch)
+                    if tar_bytes:
+                        shell.upload_tar(
+                            self._remote_dir(worker),
+                            tar_bytes,
+                            limiter=self._up_limiter,
+                        )
+            old = self._shells[i]
+            self._shells[i] = shell
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.log.warn(
+                "[sync] worker %s shell revived (%d file(s) caught up)",
+                getattr(worker, "name", i),
+                len(need),
+            )
+            return True
+        except BaseException:  # noqa: BLE001 — revive is best-effort
+            return False
+
+    def _fan_out(self, op, what: str) -> list[int]:
+        """Run ``op(i)`` on every live worker concurrently. A worker that
+        fails gets one shell-revive attempt + retry; failing that it is
+        dropped from the fan-out and the session continues — fatal only
+        when worker 0 (the downstream authority) or ALL workers are lost
+        (reference keeps single-pod all-or-nothing semantics,
+        sync_config.go:439; fan-out needs the graded version)."""
+        live = self._live_indices()
+        if not live:
+            raise SyncError("sync has no live workers left")
+        futures = {i: self._pool.submit(op, i) for i in live}
+        ok: list[int] = []
+        for i, f in futures.items():
+            try:
+                f.result()
+                ok.append(i)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+                if self._try_revive(i):
+                    try:
+                        op(i)
+                        ok.append(i)
+                        continue
+                    except BaseException as e2:  # noqa: BLE001
+                        err = e2
+                self._mark_worker_failed(i, err)
+        with self._workers_lock:
+            worker0_error = self.worker_errors.get(0)
+        if worker0_error is not None:
+            raise SyncError(f"authoritative worker 0 lost: {worker0_error}")
+        if not ok:
+            raise SyncError(f"{what} failed on every worker")
+        return ok
+
     def _apply_uploads(
         self, entries: list[FileInformation], shells: list[RemoteShell], workers: list
     ) -> None:
-        """Tar once, broadcast to every worker in parallel
+        """Tar once, broadcast to every live worker in parallel
         (reference: applyCreates/uploadArchive; fan-out per SURVEY §2.2)."""
         for batch in _batch_entries(entries):
             tar_bytes = build_tar(self.opts.local_path, batch)
@@ -439,24 +541,20 @@ class SyncSession:
                 continue
 
             def send(i: int) -> None:
-                self._upload_raw(shells[i], workers[i], tar_bytes)
+                self._upload_raw(self._shells[i], self.workers[i], tar_bytes)
 
-            futures = [self._pool.submit(send, i) for i in range(len(shells))]
-            errors = []
-            for f in futures:
-                try:
-                    f.result()
-                except BaseException as e:  # noqa: BLE001
-                    errors.append(e)
-            if errors:
-                raise SyncError(f"upload failed on {len(errors)} worker(s): {errors[0]}")
+            sent = self._fan_out(send, "upload")
             for info in batch:
                 self.index.set(info)
             self.stats["uploaded"] += len(batch)
             if self.opts.verbose:
                 for info in batch:
                     self.log.debug("[sync] upload %s", info.name)
-        self.log.info("[sync] Uploaded %d change(s) to %d worker(s)", len(entries), len(shells))
+        self.log.info(
+            "[sync] Uploaded %d change(s) to %d worker(s)",
+            len(entries),
+            len(self._live_indices()),
+        )
 
     def _upload_to(self, shell: RemoteShell, worker, entries: list[FileInformation]) -> None:
         for batch in _batch_entries(entries):
