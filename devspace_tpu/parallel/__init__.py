@@ -1,1 +1,3 @@
 from .mesh import create_mesh, mesh_shape_for  # noqa: F401
+from .fsdp import fsdp_spec, make_fsdp_train_step, shard_params  # noqa: F401
+from .sequence_parallel import ulysses_attention  # noqa: F401

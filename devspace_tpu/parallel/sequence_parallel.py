@@ -1,0 +1,66 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second canonical long-context layout next to ring attention
+(ring_attention.py). Activations flow through the network sharded on the
+sequence axis ([B, T/P, H, D]); for attention each device needs full
+sequence but only some heads, so a tiled ``jax.lax.all_to_all`` re-shards
+from sequence-parallel to head-parallel ([B, T, H/P, D]), exact local
+attention runs per head group, and a second all-to-all restores sequence
+sharding. Two collectives per attention vs ring's P ppermute steps:
+Ulysses wins when heads >= ring size and the all-to-all fits ICI;
+ring wins at extreme sequence lengths (memory stays O(T/P) throughout).
+Both are exposed so the scaffolded workloads can pick per topology.
+
+The reference has no sequence dimension at all (SURVEY §5.7) — this is
+north-star TPU compute-layer work, not reference parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import full_attention
+
+
+def ulysses_attention(
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = True,
+    batch_axis: Optional[str] = None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Build ``f(q, k, v) -> out`` with q/k/v [B, T, H, D] sharded on T
+    over ``axis``; out is sharded the same way. H must be divisible by the
+    axis size. ``attn_fn(q, k, v, causal)`` defaults to exact full
+    attention and may be swapped for the flash kernel on real shapes."""
+    n = mesh.shape[axis]
+    attend = attn_fn or full_attention
+    io_spec = P(batch_axis, axis, None, None)
+
+    def local_fn(q, k, v):
+        if q.shape[2] % n:
+            raise ValueError(
+                f"ulysses needs heads ({q.shape[2]}) divisible by the "
+                f"'{axis}' axis size ({n})"
+            )
+        # [B, T/P, H, D] -> [B, T, H/P, D]: split heads, gather sequence.
+        to_heads = lambda x: jax.lax.all_to_all(
+            x, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+        out = attend(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+        # [B, T, H/P, D] -> [B, T/P, H, D]: split sequence, gather heads.
+        return jax.lax.all_to_all(
+            out, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(io_spec, io_spec, io_spec),
+        out_specs=io_spec,
+        check_vma=False,
+    )

@@ -288,14 +288,18 @@ class SyncSession:
         if downloads:
             self._apply_downloads(downloads)
         if uploads:
-            self._apply_uploads(uploads, self._shells, self.workers)
+            self._apply_uploads(uploads)
 
         # Mirror pass for non-authoritative workers: bring each to local
-        # state (upload-only — initial sync never deletes).
+        # state (upload-only — initial sync never deletes). Graded failure
+        # semantics via _fan_out: a worker that can't be mirrored is
+        # dropped, not fatal (worker 0 is a no-op — it IS the authority).
         if len(self.workers) > 1:
             local_now = self._walk_local()
 
             def mirror(i: int) -> None:
+                if i == 0:
+                    return
                 shell = self._shells[i]
                 w = self.workers[i]
                 snap = shell.snapshot(self._remote_dir(w))
@@ -308,9 +312,7 @@ class SyncSession:
                 if need:
                     self._upload_to(shell, w, need)
 
-            futures = [self._pool.submit(mirror, i) for i in range(1, len(self.workers))]
-            for f in futures:
-                f.result()
+            self._fan_out(mirror, "initial mirror")
         self.log.done(
             "[sync] initial sync complete: %d up, %d down, index=%d",
             len(uploads),
@@ -397,7 +399,7 @@ class SyncSession:
         if removes:
             self._apply_removes(removes)
         if creates:
-            self._apply_uploads(creates, self._shells, self.workers)
+            self._apply_uploads(creates)
 
     def _walk_subtree(self, rel: str) -> list[FileInformation]:
         root = self.opts.local_path
@@ -530,9 +532,7 @@ class SyncSession:
             raise SyncError(f"{what} failed on every worker")
         return ok
 
-    def _apply_uploads(
-        self, entries: list[FileInformation], shells: list[RemoteShell], workers: list
-    ) -> None:
+    def _apply_uploads(self, entries: list[FileInformation]) -> None:
         """Tar once, broadcast to every live worker in parallel
         (reference: applyCreates/uploadArchive; fan-out per SURVEY §2.2)."""
         for batch in _batch_entries(entries):
@@ -543,7 +543,7 @@ class SyncSession:
             def send(i: int) -> None:
                 self._upload_raw(self._shells[i], self.workers[i], tar_bytes)
 
-            sent = self._fan_out(send, "upload")
+            self._fan_out(send, "upload")
             for info in batch:
                 self.index.set(info)
             self.stats["uploaded"] += len(batch)
@@ -569,13 +569,15 @@ class SyncSession:
         def send(i: int) -> None:
             self._shells[i].remove_paths(self._remote_dir(self.workers[i]), relpaths)
 
-        futures = [self._pool.submit(send, i) for i in range(len(self._shells))]
-        for f in futures:
-            f.result()
+        self._fan_out(send, "remove")
         for rel in relpaths:
             self.index.remove(rel)
         self.stats["removed_remote"] += len(relpaths)
-        self.log.info("[sync] Removed %d path(s) on %d worker(s)", len(relpaths), len(self._shells))
+        self.log.info(
+            "[sync] Removed %d path(s) on %d worker(s)",
+            len(relpaths),
+            len(self._live_indices()),
+        )
 
     # -- downstream --------------------------------------------------------
     def _downstream_loop(self) -> None:
@@ -701,13 +703,11 @@ class SyncSession:
             ]
 
             def send(i: int) -> None:
+                if i == 0:
+                    return  # source of truth — it already has these
                 self._upload_to(self._shells[i], self.workers[i], entries)
 
-            futures = [
-                self._pool.submit(send, i) for i in range(1, len(self.workers))
-            ]
-            for f in futures:
-                f.result()
+            self._fan_out(send, "download mirror")
 
     def _apply_local_removes(self, relpaths: list[str]) -> None:
         """Careful local deletion (reference: deleteSafeRecursive,

@@ -44,3 +44,87 @@ def latest_step_dir(root: str) -> Optional[str]:
     except OSError:
         return None
     return os.path.join(root, steps[-1]) if steps else None
+
+
+class CheckpointManager:
+    """Step-managed checkpointing with retention and resume.
+
+    The training-side analogue of the dev loop's generated-state cache
+    (SURVEY §5.4 — every stage incremental/resumable): ``maybe_save``
+    checkpoints every ``save_interval`` steps into ``root/step_NNNNNNNN``,
+    keeps the newest ``max_to_keep``, and ``restore_or_init`` makes a cold
+    start and a resumed run the same call site. Multi-host safe: Orbax
+    coordinates the processes; every host must call save/restore
+    collectively.
+    """
+
+    def __init__(
+        self, root: str, save_interval: int = 100, max_to_keep: int = 3
+    ):
+        self.root = os.path.abspath(root)
+        self.save_interval = max(1, int(save_interval))
+        self.max_to_keep = max(1, int(max_to_keep))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step:08d}")
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        for d in os.listdir(self.root):
+            if d.startswith("step_"):
+                try:
+                    steps.append(int(d[len("step_"):]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any) -> str:
+        path = self._dir(step)
+        save_checkpoint(path, state, force=True)
+        self._gc()
+        return path
+
+    def maybe_save(self, step: int, state: Any) -> Optional[str]:
+        """Save when the retention policy says so (every save_interval
+        steps); returns the path when a checkpoint was written."""
+        if step % self.save_interval:
+            return None
+        return self.save(step, state)
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        return restore_checkpoint(self._dir(step), template)
+
+    def restore_or_init(
+        self, init_fn, template: Any = None
+    ) -> tuple[Any, int]:
+        """``(state, step)``: the latest checkpoint, or ``(init_fn(), 0)``
+        on a cold start. One call site for both paths makes the scaffolded
+        train loops resumable by construction.
+
+        Without an explicit ``template`` the restore structure is derived
+        from ``jax.eval_shape(init_fn)`` (no arrays materialized) — Orbax
+        would otherwise flatten optax's namedtuple state into plain lists
+        and the resumed pytree would no longer match the jitted step's
+        in_shardings. Pass a concrete template (e.g. sharded abstract
+        arrays) to control placement on restore."""
+        step = self.latest_step()
+        if step is None:
+            return init_fn(), 0
+        if template is None:
+            template = jax.eval_shape(init_fn)
+        return self.restore(step, template), step
+
+    def _gc(self) -> None:
+        import shutil
+
+        steps = self.all_steps()
+        for step in steps[: -self.max_to_keep]:
+            shutil.rmtree(self._dir(step), ignore_errors=True)

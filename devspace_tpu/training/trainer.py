@@ -191,6 +191,34 @@ def make_moe_lm_train_step(
     return _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate)
 
 
+def train_loop(
+    step_fn: Callable,
+    state,
+    batches,
+    checkpoint_manager=None,
+    start_step: int = 0,
+    log_every: int = 0,
+    logger=None,
+):
+    """Drive ``step_fn(state, batch) -> (state, loss)`` over an iterable of
+    batches with optional periodic checkpointing (CheckpointManager) and
+    logging. Returns ``(state, last_loss)``. Combined with
+    ``CheckpointManager.restore_or_init`` this makes every scaffolded
+    workload resumable: pass its returned step as ``start_step`` and skip
+    already-consumed data upstream."""
+    loss = None
+    step = start_step
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        step += 1
+        if log_every and logger and step % log_every == 0:
+            scalar = loss["loss"] if isinstance(loss, dict) else loss
+            logger.info("[train] step %d loss %.4f", step, float(scalar))
+        if checkpoint_manager is not None:
+            checkpoint_manager.maybe_save(step, state)
+    return state, loss
+
+
 def accumulate_gradients(loss_fn: Callable, n_accum: int) -> Callable:
     """Gradient accumulation via lax.scan over microbatches: trades HBM for
     arithmetic without leaving the compiled step. ``loss_fn(params, batch)``

@@ -75,6 +75,80 @@ def test_tp_mlp_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+def test_fsdp_step_matches_single_device():
+    from devspace_tpu.parallel.fsdp import (
+        fsdp_leaf_spec,
+        fsdp_spec,
+        make_fsdp_train_step,
+    )
+
+    mesh = create_mesh({"data": -1})
+    params = {
+        "w1": jax.random.normal(jax.random.PRNGKey(0), (16, 64)) * 0.1,
+        "w2": jax.random.normal(jax.random.PRNGKey(1), (64, 4)) * 0.1,
+        "b": jnp.zeros((4,)),
+    }
+    xs = jax.random.normal(jax.random.PRNGKey(2), (32, 16))
+    ys = jax.random.normal(jax.random.PRNGKey(3), (32, 4))
+    batch = {"x": xs, "y": ys}
+
+    def loss_fn(p, b):
+        pred = jnp.tanh(b["x"] @ p["w1"]) @ p["w2"] + p["b"]
+        return jnp.mean((pred - b["y"]) ** 2)
+
+    # spec rule: big leaves shard their largest divisible dim, tiny replicate
+    spec = fsdp_spec(params, mesh, min_size=64)
+    assert spec["w1"] == P(None, "data")
+    assert spec["w2"] == P("data", None)
+    assert spec["b"] == P()
+    assert fsdp_leaf_spec((), "data", 8) == P()
+
+    opt = optax.adam(1e-2)
+    ref_state = opt.init(params)
+    grads = jax.grad(loss_fn)(params, batch)
+    updates, _ = opt.update(grads, ref_state, params)
+    ref = optax.apply_updates(params, updates)
+    ref_loss = float(loss_fn(params, batch))
+
+    step, p_sh, o_sh = make_fsdp_train_step(
+        loss_fn, opt, mesh, params, min_size=64
+    )
+    # params and adam mu/nu really live sharded over the data axis
+    assert p_sh["w1"].sharding.spec == P(None, "data")
+    assert o_sh[0].mu["w1"].sharding.spec == P(None, "data")
+    new_params, _, loss = step(p_sh, o_sh, shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_params["w1"]), np.asarray(ref["w1"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_params["b"]), np.asarray(ref["b"]), rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_full(causal):
+    from devspace_tpu.parallel.sequence_parallel import ulysses_attention
+
+    mesh = create_mesh({"seq": 8})
+    b, t, h, d = 2, 64, 8, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d), jnp.float32)
+    out = ulysses_attention(mesh, causal=causal)(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from devspace_tpu.parallel.sequence_parallel import ulysses_attention
+
+    mesh = create_mesh({"seq": 8})
+    q = jnp.zeros((1, 16, 4, 8))  # 4 heads on an 8-way axis
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(mesh)(q, q, q)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_full(causal):
     mesh = create_mesh({"seq": 8})

@@ -287,3 +287,64 @@ def test_remote_dir_delete_spares_local_edits(tmp_path, cluster):
         assert (local / "d" / "f.txt").read_text() == "v2-local-edit-longer"
     finally:
         session.stop()
+
+
+def test_dropped_worker_does_not_kill_session(tmp_path, cluster, monkeypatch):
+    """Graded partial-failure semantics (SURVEY §7 hard part #2): after a
+    non-authoritative worker is permanently dropped from the fan-out,
+    removes, uploads and downstream mirrors must keep flowing to the
+    surviving workers instead of raising through the dead worker's closed
+    shell and tearing the session down."""
+    session, local, workers = make_session(tmp_path, cluster, n_workers=3)
+    write_file(str(local / "keep.txt"), "v1")
+    write_file(str(local / "doomed.txt"), "bye")
+    session.start()
+    try:
+        for w in workers:
+            wait_for(
+                lambda w=w: os.path.exists(remote_path(cluster, w, "doomed.txt")),
+                msg="initial fan-out",
+            )
+        # Permanently lose worker 2: mark it failed and make any revive
+        # attempt (a fresh exec) fail like a deleted pod would.
+        real_exec = cluster.exec_stream
+
+        def exec_stream(pod, *a, **kw):
+            name = getattr(pod, "name", pod)
+            if name == workers[2].name:
+                raise RuntimeError("pod gone")
+            return real_exec(pod, *a, **kw)
+
+        monkeypatch.setattr(cluster, "exec_stream", exec_stream)
+        session._mark_worker_failed(2, RuntimeError("pod gone"))
+
+        # upstream remove must fan out to survivors without dying
+        os.unlink(str(local / "doomed.txt"))
+        for w in workers[:2]:
+            wait_for(
+                lambda w=w: not os.path.exists(remote_path(cluster, w, "doomed.txt")),
+                msg="remove on survivors",
+            )
+        # downstream change on worker 0 must still mirror to worker 1
+        w0 = cluster.translate_path(workers[0], "/app")
+        write_file(os.path.join(w0, "from_remote.txt"), "hello")
+        wait_for(
+            lambda: (local / "from_remote.txt").exists(),
+            msg="download from authority",
+        )
+        wait_for(
+            lambda: os.path.exists(remote_path(cluster, workers[1], "from_remote.txt")),
+            msg="mirror to surviving worker",
+        )
+        # upstream create still reaches survivors
+        write_file(str(local / "late.txt"), "late")
+        for w in workers[:2]:
+            wait_for(
+                lambda w=w: os.path.exists(remote_path(cluster, w, "late.txt")),
+                msg="upload to survivors",
+            )
+        assert session.error is None
+        assert 2 in session.worker_errors
+    finally:
+        session.stop()
+    assert session.error is None

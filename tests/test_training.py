@@ -1,0 +1,104 @@
+"""Checkpoint/resume subsystem tests (SURVEY §5.4 analogue for model
+state): step-managed save, retention GC, and a killed-and-resumed train
+loop that lands exactly where the uninterrupted run does."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from devspace_tpu.training.checkpoint import (
+    CheckpointManager,
+    latest_step_dir,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from devspace_tpu.training.trainer import train_loop
+
+
+def _state(seed: int = 0):
+    return {
+        "params": {"w": jax.random.normal(jax.random.PRNGKey(seed), (8, 4))},
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = _state()
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state)
+    restored = restore_checkpoint(path)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval=10, max_to_keep=2)
+    assert mgr.latest_step() is None
+    assert mgr.maybe_save(5, _state()) is None  # off-interval: skipped
+    for step in (10, 20, 30):
+        assert mgr.maybe_save(step, _state(step)) is not None
+    assert mgr.all_steps() == [20, 30]  # oldest GC'd
+    assert mgr.latest_step() == 30
+    assert latest_step_dir(str(tmp_path)).endswith("step_00000030")
+
+
+def test_restore_or_init_cold_and_resume(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), save_interval=1)
+    state, step = mgr.restore_or_init(_state)
+    assert step == 0
+    mgr.save(7, state)
+    restored, step = mgr.restore_or_init(_state)
+    assert step == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+
+
+def test_interrupted_loop_resumes_to_same_result(tmp_path):
+    opt = optax.sgd(0.1)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (6, 4, 8))
+    ys = jax.random.normal(jax.random.PRNGKey(2), (6, 4, 4))
+    batches = [{"x": xs[i], "y": ys[i]} for i in range(6)]
+
+    def make_step():
+        def loss_fn(params, batch):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        @jax.jit
+        def step(state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+            updates, opt_state = opt.update(grads, state["opt_state"])
+            return {
+                "params": optax.apply_updates(state["params"], updates),
+                "opt_state": opt_state,
+            }, loss
+
+        return step
+
+    def init():
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 4)) * 0.1}
+        return {"params": params, "opt_state": opt.init(params)}
+
+    step_fn = make_step()
+    # uninterrupted reference over all 6 batches
+    ref_state, _ = train_loop(step_fn, init(), batches)
+
+    # run 1: crashes after 3 batches (checkpoint every step)
+    mgr = CheckpointManager(str(tmp_path), save_interval=1, max_to_keep=2)
+    train_loop(step_fn, init(), batches[:3], checkpoint_manager=mgr)
+    assert mgr.latest_step() == 3
+
+    # run 2: resume from the checkpoint, consume the remaining data
+    state, start = mgr.restore_or_init(init)
+    assert start == 3
+    state, _ = train_loop(
+        step_fn, state, batches[start:], checkpoint_manager=mgr, start_step=start
+    )
+    np.testing.assert_allclose(
+        np.asarray(state["params"]["w"]),
+        np.asarray(ref_state["params"]["w"]),
+        rtol=1e-6,
+    )
