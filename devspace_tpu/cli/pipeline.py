@@ -138,6 +138,14 @@ class DevLoop:
         if self.logmux:
             self.logmux.stop()
         self.sync_sessions, self.forwarders, self.watcher = [], [], None
+        # Force-close any exec/attach stream a service left hanging — a
+        # half-open terminal or sync shell must not outlive the session
+        # (reference: kubectl/upgrade_wrapper.go via services/terminal.go:113).
+        tracker = getattr(self.ctx.backend, "connections", None)
+        if tracker is not None:
+            closed = tracker.close_all()
+            if closed:
+                self.log.debug("[dev] force-closed %d remote streams", closed)
 
     # -- the loop ----------------------------------------------------------
     def run(self) -> int:

@@ -15,7 +15,7 @@ from typing import Any, Iterator, Optional
 from ..utils import log as logutil
 from . import exec as kexec
 from .portforward import PortForwarder, WSPortTunnel
-from .streams import RemoteProcess
+from .streams import ConnectionTracker, RemoteProcess
 from .transport import ApiError, KubeTransport
 
 OK_POD_STATUS = {"Running", "Completed", "Succeeded"}
@@ -157,6 +157,9 @@ class KubeClient:
     ):
         self.transport = transport
         self.log = logger or logutil.get_logger()
+        # Tracks live exec/attach streams so `dev` teardown can force-close
+        # hung connections (reference: kubectl/upgrade_wrapper.go).
+        self.connections = ConnectionTracker()
 
     @property
     def default_namespace(self) -> str:
@@ -309,8 +312,11 @@ class KubeClient:
             if isinstance(pod, Pod)
             else (namespace or self.default_namespace)
         )
-        return kexec.exec_stream(
-            self.transport, name, ns, command, container=container, tty=tty, stdin=stdin
+        return self.connections.track(
+            kexec.exec_stream(
+                self.transport, name, ns, command,
+                container=container, tty=tty, stdin=stdin,
+            )
         )
 
     def exec_buffered(
@@ -345,8 +351,10 @@ class KubeClient:
             if isinstance(pod, Pod)
             else (namespace or self.default_namespace)
         )
-        return kexec.attach_stream(
-            self.transport, name, ns, container=container, tty=tty, stdin=stdin
+        return self.connections.track(
+            kexec.attach_stream(
+                self.transport, name, ns, container=container, tty=tty, stdin=stdin
+            )
         )
 
     def logs(

@@ -22,7 +22,7 @@ from typing import Iterator, Optional
 from ..utils import log as logutil
 from .client import CRITICAL_STATUS, Pod, get_pod_status, selector_string
 from .portforward import LocalPortTunnel, PortForwarder
-from .streams import RemoteProcess, SubprocessRemoteProcess
+from .streams import ConnectionTracker, RemoteProcess, SubprocessRemoteProcess
 
 
 class FakeCluster:
@@ -45,6 +45,7 @@ class FakeCluster:
         self.namespaces: set[str] = {"default"}
         self.pod_logs: dict[tuple[str, str], list[bytes]] = {}
         self.pod_ports: dict[tuple[str, str, int], int] = {}  # remote -> local
+        self.connections = ConnectionTracker()
         # Persistence lets separate CLI invocations (deploy, then dev) share
         # one fake cluster, like a real API server would.
         self._persist = persist
@@ -277,7 +278,7 @@ class FakeCluster:
         )
         self._require_pod(name, ns)
         workdir = self.pod_dir(name, ns)
-        return SubprocessRemoteProcess(command, cwd=workdir)
+        return self.connections.track(SubprocessRemoteProcess(command, cwd=workdir))
 
     def _require_pod(self, name: str, ns: str) -> None:
         with self._lock:

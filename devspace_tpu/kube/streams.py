@@ -25,6 +25,48 @@ class StreamClosed(Exception):
     pass
 
 
+class ConnectionTracker:
+    """Registry of live remote streams so a session teardown can force-close
+    hung connections (reference: kubectl/upgrade_wrapper.go:20-52, used by
+    services/terminal.go:113 to kill SPDY connections on exit).
+
+    Processes are held strongly — a handle dropped on an error path must
+    still be reachable at teardown (GC would not kill the remote command) —
+    and exited ones are pruned on every registration."""
+
+    def __init__(self):
+        self._procs: list["RemoteProcess"] = []
+        self._lock = threading.Lock()
+
+    def track(self, proc: "RemoteProcess") -> "RemoteProcess":
+        with self._lock:
+            self._procs = [p for p in self._procs if self._alive(p)]
+            self._procs.append(proc)
+        return proc
+
+    @staticmethod
+    def _alive(p: "RemoteProcess") -> bool:
+        try:
+            return p.poll() is None
+        except Exception:  # noqa: BLE001 — broken stream counts as dead
+            return False
+
+    def close_all(self) -> int:
+        """Force-close every tracked stream still running; returns the
+        number closed."""
+        with self._lock:
+            procs, self._procs = self._procs, []
+        closed = 0
+        for p in procs:
+            try:
+                if p.poll() is None:
+                    p.terminate()
+                    closed += 1
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+        return closed
+
+
 class StreamBuffer:
     """Thread-safe producer/consumer byte buffer with blocking reads."""
 

@@ -229,8 +229,12 @@ class SyncSession:
         self._stopped.set()
         if self._watcher:
             self._watcher.stop()
-        for sh in self._shells:
-            sh.close()
+        # Close shells under the workers lock: _try_revive stores a revived
+        # shell under the same lock after re-checking _stopped, so every
+        # shell is either closed here or never stored.
+        with self._workers_lock:
+            for sh in self._shells:
+                sh.close()
         if self._down_shell:
             self._down_shell.close()
         self._pool.shutdown(wait=False)
@@ -461,12 +465,22 @@ class SyncSession:
         handles a container restart (exec dies, pod comes back). Presence
         parity only: files deleted while the worker was dead are cleaned
         up by the next remove that targets them."""
+        if self._stopped.is_set():
+            # A stopping session must not open fresh exec streams — they
+            # would outlive teardown's ConnectionTracker.close_all().
+            return False
         worker = self.workers[i]
         try:
             proc = self.backend.exec_stream(
                 worker, ["sh"], container=self.opts.container, tty=False
             )
             shell = RemoteShell(proc, label=f"up{getattr(worker, 'name', i)}")
+            if self._stopped.is_set():
+                # stop() raced the exec: it may already have run its close
+                # loop (and the pipeline its close_all), so nothing else
+                # would ever close this stream — close it here.
+                shell.close()
+                return False
             snap = shell.snapshot(self._remote_dir(worker))
             need = [
                 info
@@ -483,8 +497,14 @@ class SyncSession:
                             tar_bytes,
                             limiter=self._up_limiter,
                         )
-            old = self._shells[i]
-            self._shells[i] = shell
+            with self._workers_lock:
+                if self._stopped.is_set():
+                    # stop() already closed every stored shell; storing now
+                    # would leak this one past teardown.
+                    shell.close()
+                    return False
+                old = self._shells[i]
+                self._shells[i] = shell
             try:
                 old.close()
             except Exception:  # noqa: BLE001

@@ -35,15 +35,27 @@ def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
     return _checkpointer().restore(path)
 
 
+def list_step_dirs(root: str) -> list[tuple[int, str]]:
+    """All ``root/step_NNNNNNNN`` checkpoint dirs as (step, path), numeric
+    order — the one parser of the step-dir naming convention."""
+    out: list[tuple[int, str]] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for d in names:
+        if d.startswith("step_"):
+            try:
+                out.append((int(d[len("step_"):]), os.path.join(root, d)))
+            except ValueError:
+                continue  # e.g. an orbax tmp dir
+    return sorted(out)
+
+
 def latest_step_dir(root: str) -> Optional[str]:
     """Step-numbered checkpoint dirs: root/step_000010 etc."""
-    try:
-        steps = sorted(
-            d for d in os.listdir(root) if d.startswith("step_")
-        )
-    except OSError:
-        return None
-    return os.path.join(root, steps[-1]) if steps else None
+    steps = list_step_dirs(root)
+    return steps[-1][1] if steps else None
 
 
 class CheckpointManager:
@@ -70,14 +82,7 @@ class CheckpointManager:
         return os.path.join(self.root, f"step_{step:08d}")
 
     def all_steps(self) -> list[int]:
-        steps = []
-        for d in os.listdir(self.root):
-            if d.startswith("step_"):
-                try:
-                    steps.append(int(d[len("step_"):]))
-                except ValueError:
-                    continue
-        return sorted(steps)
+        return [step for step, _ in list_step_dirs(self.root)]
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()

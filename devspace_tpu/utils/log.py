@@ -147,12 +147,21 @@ class StdoutLogger(Logger):
 
 class FileLogger(Logger):
     """JSON-lines file logger (reference: logrus JSON to
-    .devspace/logs/<name>.log, pkg/util/log/file_logger.go)."""
+    .devspace/logs/<name>.log, pkg/util/log/file_logger.go). Oversized
+    logs are rotated to ``<path>.old`` on open (reference: sync.log
+    rotation, pkg/devspace/sync/util.go:305-340)."""
+
+    MAX_BYTES = 10 * 1024 * 1024
 
     def __init__(self, path: str, level: str = "debug"):
         super().__init__(level)
         self.path = path
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            if os.path.getsize(path) > self.MAX_BYTES:
+                os.replace(path, path + ".old")
+        except OSError:
+            pass
         self._fh = open(path, "a", encoding="utf-8")
 
     def _write(self, tag: str, msg: str) -> None:

@@ -318,3 +318,16 @@ def test_ws_exec_channel_demux():
     assert proc.stderr.read_exact(8, timeout=5) == b"err-data"
     server.close()
     assert proc.wait(5) == 42
+
+
+def test_connection_tracker_force_close(tmp_path):
+    """Teardown must be able to force-close streams a session left hanging
+    (reference: kubectl/upgrade_wrapper.go:20-52, services/terminal.go:113)."""
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("w-0", worker_id=0)
+    proc = fc.exec_stream("w-0", ["sh", "-c", "sleep 60"])
+    assert proc.poll() is None
+    assert fc.connections.close_all() == 1
+    assert proc.wait(5) is not None
+    # already-dead streams are not closed again
+    assert fc.connections.close_all() == 0

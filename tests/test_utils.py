@@ -114,3 +114,22 @@ def test_trace_spans(tmp_path):
 
     data = json.loads(dest.read_text())
     assert {e["name"] for e in data["traceEvents"]} == {"outer", "inner", "failing"}
+
+
+def test_file_logger_rotation(tmp_path):
+    """Oversized logs rotate to .old on open (reference: sync/util.go:305-340)."""
+    from devspace_tpu.utils import log as logutil
+
+    path = tmp_path / "logs" / "sync.log"
+    path.parent.mkdir()
+    path.write_text("x" * 64)
+    old_max = logutil.FileLogger.MAX_BYTES
+    logutil.FileLogger.MAX_BYTES = 16
+    try:
+        fl = logutil.FileLogger(str(path))
+        fl.info("fresh entry")
+        fl.close()
+    finally:
+        logutil.FileLogger.MAX_BYTES = old_max
+    assert (tmp_path / "logs" / "sync.log.old").read_text() == "x" * 64
+    assert "fresh entry" in path.read_text()
