@@ -62,6 +62,20 @@ class Context:
         return "default"
 
     @property
+    def is_gke(self) -> bool:
+        """GKE contexts are named ``gke_<project>_<zone>_<cluster>`` by
+        ``gcloud container clusters get-credentials`` (reference:
+        kubectl/util.go:46 keys its RBAC ensure off the gcloud account).
+
+        Asks the backend which context it actually connected with —
+        inline-cluster and fake backends carry no context name and
+        correctly report False.
+        """
+        transport = getattr(self.backend, "transport", None)
+        name = getattr(transport, "context_name", None)
+        return bool(name) and str(name).startswith("gke_")
+
+    @property
     def backend(self):
         if self._backend is None:
             self._backend = self._create_backend()

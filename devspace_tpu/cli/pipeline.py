@@ -48,6 +48,8 @@ def build_and_deploy(
     backend = ctx.backend
     with span("pipeline", dev_mode=dev_mode):
         backend.ensure_namespace(ctx.namespace)
+        if getattr(backend, "ensure_cluster_admin_binding", None) and ctx.is_gke:
+            backend.ensure_cluster_admin_binding()
         with span("registries"):
             pull_secrets = init_registries(backend, config, ctx.namespace, log)
         cache = ctx.loader.generated.get_cache(dev_mode)

@@ -9,6 +9,8 @@ pod polling selector (GetNewestRunningPod, 171), EnsureDefaultNamespace
 
 from __future__ import annotations
 
+import re
+import subprocess
 import time
 from typing import Any, Iterator, Optional
 
@@ -160,6 +162,7 @@ class KubeClient:
         # Tracks live exec/attach streams so `dev` teardown can force-close
         # hung connections (reference: kubectl/upgrade_wrapper.go).
         self.connections = ConnectionTracker()
+        self._rbac_ensured = False
 
     @property
     def default_namespace(self) -> str:
@@ -198,6 +201,71 @@ class KubeClient:
                 },
             )
             self.log.done(f"Created namespace {namespace}")
+
+    def ensure_cluster_admin_binding(self, account: Optional[str] = None) -> None:
+        """On GKE, grant the active gcloud account cluster-admin so RBAC
+        objects (e.g. chart-rendered Roles) can be created (reference:
+        kubectl/util.go:46 EnsureGoogleCloudClusterRoleBinding).
+
+        Best-effort: no-op when the account can't be determined, the
+        binding exists, or the API is unreachable. Memoized per client so
+        dev-loop reloads don't re-run gcloud + the GET every pass.
+        """
+        if self._rbac_ensured:
+            return
+        if account is None:
+            try:
+                out = subprocess.run(
+                    ["gcloud", "config", "list", "account", "--format", "value(core.account)"],
+                    capture_output=True,
+                    text=True,
+                    timeout=10,
+                    check=False,
+                )
+                account = (out.stdout or "").strip()
+            except (OSError, subprocess.SubprocessError):
+                account = ""
+        if not account:
+            return
+        name = "devspace-user-" + re.sub(r"[^a-z0-9.-]", "-", account.lower())
+        try:
+            self.transport.request(
+                "GET",
+                f"/apis/rbac.authorization.k8s.io/v1/clusterrolebindings/{name}",
+            )
+            self._rbac_ensured = True
+            return
+        except ApiError as e:
+            if e.status != 404:
+                return  # forbidden etc. — best-effort, as in the reference
+        except OSError:
+            return  # connection-level failure must never block the deploy
+        try:
+            self.transport.request(
+                "POST",
+                "/apis/rbac.authorization.k8s.io/v1/clusterrolebindings",
+                body={
+                    "apiVersion": "rbac.authorization.k8s.io/v1",
+                    "kind": "ClusterRoleBinding",
+                    "metadata": {"name": name},
+                    "roleRef": {
+                        "apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "ClusterRole",
+                        "name": "cluster-admin",
+                    },
+                    "subjects": [
+                        {
+                            "apiGroup": "rbac.authorization.k8s.io",
+                            "kind": "User",
+                            "name": account,
+                        }
+                    ],
+                },
+            )
+            self.log.done(f"Created ClusterRoleBinding {name}")
+            self._rbac_ensured = True
+        except (ApiError, OSError):
+            pass
 
     # -- pods --------------------------------------------------------------
     def list_pods(

@@ -176,3 +176,23 @@ def test_install_and_upgrade(tmp_path, monkeypatch):
 
     # upgrade without --apply just prints instructions
     assert main(["upgrade"]) == 0
+
+
+def test_install_update_path(tmp_path, monkeypatch):
+    """--update-path persists the PATH addition to the shell rc
+    (reference: pkg/util/envutil via cmd/install.go)."""
+    from devspace_tpu.cli.main import main
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("SHELL", "/bin/bash")
+    monkeypatch.setenv("PATH", "/usr/bin")
+    bin_dir = tmp_path / "bin"
+    assert main(["install", "--bin-dir", str(bin_dir), "--update-path"]) == 0
+    rc = (tmp_path / ".bashrc").read_text()
+    assert f'export PATH="{bin_dir}:$PATH"' in rc
+    # idempotent: second run doesn't duplicate the line
+    assert main(["install", "--bin-dir", str(bin_dir), "--update-path"]) == 0
+    assert rc.count("added by devspace-tpu") == (tmp_path / ".bashrc").read_text().count(
+        "added by devspace-tpu"
+    )

@@ -331,3 +331,69 @@ def test_connection_tracker_force_close(tmp_path):
     assert proc.wait(5) is not None
     # already-dead streams are not closed again
     assert fc.connections.close_all() == 0
+
+
+class _RecordingTransport:
+    """Minimal transport stub: records requests, scripted responses."""
+
+    default_namespace = "default"
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def request(self, method, path, body=None, **kw):
+        self.calls.append((method, path, body))
+        resp = self.responses.pop(0)
+        if isinstance(resp, Exception):
+            raise resp
+        return resp
+
+
+def test_ensure_cluster_admin_binding_creates_when_missing():
+    """GKE RBAC ensure (reference: kubectl/util.go:46
+    EnsureGoogleCloudClusterRoleBinding): GET 404 -> POST binding."""
+    from devspace_tpu.kube.client import KubeClient
+
+    transport = _RecordingTransport([ApiError(404, "nf"), {}])
+    client = KubeClient(transport)
+    client.ensure_cluster_admin_binding(account="Dev@Example.com")
+    assert [c[0] for c in transport.calls] == ["GET", "POST"]
+    body = transport.calls[1][2]
+    assert body["subjects"][0]["name"] == "Dev@Example.com"
+    assert body["roleRef"]["name"] == "cluster-admin"
+    # name is sanitized to a valid k8s object name
+    assert body["metadata"]["name"] == "devspace-user-dev-example.com"
+
+
+def test_ensure_cluster_admin_binding_noops():
+    from devspace_tpu.kube.client import KubeClient
+
+    # binding already exists -> GET only
+    transport = _RecordingTransport([{}])
+    KubeClient(transport).ensure_cluster_admin_binding(account="a@b.c")
+    assert [c[0] for c in transport.calls] == ["GET"]
+    # forbidden -> best-effort, no POST, no raise
+    transport = _RecordingTransport([ApiError(403, "forbidden")])
+    KubeClient(transport).ensure_cluster_admin_binding(account="a@b.c")
+    assert [c[0] for c in transport.calls] == ["GET"]
+    # no account determinable -> no requests at all
+    transport = _RecordingTransport([])
+    KubeClient(transport).ensure_cluster_admin_binding(account="")
+    assert transport.calls == []
+
+
+def test_ensure_cluster_admin_binding_memoized_and_net_safe():
+    from devspace_tpu.kube.client import KubeClient
+
+    # connection-level failure is swallowed (best-effort) and not memoized
+    transport = _RecordingTransport([OSError("unreachable")])
+    client = KubeClient(transport)
+    client.ensure_cluster_admin_binding(account="a@b.c")
+    assert [c[0] for c in transport.calls] == ["GET"]
+    # success is memoized: second call issues no requests
+    transport = _RecordingTransport([ApiError(404, "nf"), {}])
+    client = KubeClient(transport)
+    client.ensure_cluster_admin_binding(account="a@b.c")
+    client.ensure_cluster_admin_binding(account="a@b.c")
+    assert [c[0] for c in transport.calls] == ["GET", "POST"]
