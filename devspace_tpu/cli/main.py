@@ -874,35 +874,35 @@ def cmd_install(args) -> int:
         )
     os.chmod(launcher, 0o755)
     log.done("[install] wrote %s", launcher)
-    if bin_dir not in os.environ.get("PATH", "").split(os.pathsep):
-        if getattr(args, "update_path", False):
-            # Persist the PATH addition (reference: pkg/util/envutil used by
-            # cmd/install.go to make the install survive new shells).
-            shell = os.path.basename(os.environ.get("SHELL", "sh"))
-            rc = {
-                "bash": "~/.bashrc",
-                "zsh": "~/.zshrc",
-                "fish": "~/.config/fish/config.fish",
-            }.get(shell, "~/.profile")
-            rc_path = os.path.expanduser(rc)
-            if shell == "fish":
-                line = f'set -gx PATH "{bin_dir}" $PATH'
-            else:
-                line = f'export PATH="{bin_dir}:$PATH"'
-            existing = ""
-            if os.path.isfile(rc_path):
-                with open(rc_path, "r", encoding="utf-8") as fh:
-                    existing = fh.read()
-            if line not in existing:
-                os.makedirs(os.path.dirname(rc_path), exist_ok=True)
-                with open(rc_path, "a", encoding="utf-8") as fh:
-                    fh.write(f"\n# added by devspace-tpu install\n{line}\n")
-                log.done("[install] added %s to PATH via %s", bin_dir, rc)
+    if getattr(args, "update_path", False):
+        # Persist the PATH addition to the shell rc — keyed off the rc
+        # file's content, not the live PATH, which may only transiently
+        # contain bin_dir (reference: pkg/util/envutil via cmd/install.go).
+        shell = os.path.basename(os.environ.get("SHELL", "sh"))
+        rc = {
+            "bash": "~/.bashrc",
+            "zsh": "~/.zshrc",
+            "fish": "~/.config/fish/config.fish",
+        }.get(shell, "~/.profile")
+        rc_path = os.path.expanduser(rc)
+        if shell == "fish":
+            line = f'set -gx PATH "{bin_dir}" $PATH'
         else:
-            log.warn(
-                "[install] %s is not on PATH — rerun with --update-path or add it manually",
-                bin_dir,
-            )
+            line = f'export PATH="{bin_dir}:$PATH"'
+        existing = ""
+        if os.path.isfile(rc_path):
+            with open(rc_path, "r", encoding="utf-8") as fh:
+                existing = fh.read()
+        if line not in existing:
+            os.makedirs(os.path.dirname(rc_path), exist_ok=True)
+            with open(rc_path, "a", encoding="utf-8") as fh:
+                fh.write(f"\n# added by devspace-tpu install\n{line}\n")
+            log.done("[install] added %s to PATH via %s", bin_dir, rc)
+    elif bin_dir not in os.environ.get("PATH", "").split(os.pathsep):
+        log.warn(
+            "[install] %s is not on PATH — rerun with --update-path or add it manually",
+            bin_dir,
+        )
     return 0
 
 

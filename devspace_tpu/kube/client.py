@@ -208,11 +208,13 @@ class KubeClient:
         kubectl/util.go:46 EnsureGoogleCloudClusterRoleBinding).
 
         Best-effort: no-op when the account can't be determined, the
-        binding exists, or the API is unreachable. Memoized per client so
-        dev-loop reloads don't re-run gcloud + the GET every pass.
+        binding exists, or the API is unreachable. Attempted once per
+        client — success or failure — so dev-loop reloads never re-pay
+        the gcloud subprocess or the API round-trip.
         """
         if self._rbac_ensured:
             return
+        self._rbac_ensured = True
         if account is None:
             try:
                 out = subprocess.run(
@@ -233,7 +235,6 @@ class KubeClient:
                 "GET",
                 f"/apis/rbac.authorization.k8s.io/v1/clusterrolebindings/{name}",
             )
-            self._rbac_ensured = True
             return
         except ApiError as e:
             if e.status != 404:
@@ -263,7 +264,6 @@ class KubeClient:
                 },
             )
             self.log.done(f"Created ClusterRoleBinding {name}")
-            self._rbac_ensured = True
         except (ApiError, OSError):
             pass
 

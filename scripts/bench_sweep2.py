@@ -1,0 +1,117 @@
+"""Perf sweep for the ResNet-50 bench: BN dtype x batch size.
+
+Run each variant in-process sequentially (single TPU chip). Prints one
+line per variant to stderr and a summary at the end.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def run(batch: int, bn_f32: bool, steps: int = 20, warmup: int = 3) -> float:
+    from devspace_tpu.models import resnet as R
+    from devspace_tpu.training.trainer import make_classifier_train_step
+    from functools import partial
+    import flax.linen as nn
+
+    dtype = jnp.bfloat16
+
+    class Net(R.ResNet):
+        def setup(self):
+            pass
+
+    # Rebuild ResNet with configurable BN dtype by monkeypatching the norm
+    # partial: copy of ResNet.__call__ is too invasive; instead subclass.
+    class ResNetBN(nn.Module):
+        stage_sizes = (3, 4, 6, 3)
+        num_classes: int = 1000
+        dtype2: jnp.dtype = jnp.bfloat16
+        bn_f32: bool = True
+
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            conv = partial(nn.Conv, use_bias=False, dtype=self.dtype2, padding="SAME")
+            norm = partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=jnp.float32 if self.bn_f32 else self.dtype2,
+            )
+            x = x.astype(self.dtype2)
+            x = conv(64, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for i, block_size in enumerate(self.stage_sizes):
+                for j in range(block_size):
+                    strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                    x = R.BottleneckBlock(
+                        filters=64 * 2**i,
+                        strides=strides,
+                        conv=conv,
+                        norm=norm,
+                        act=nn.relu,
+                    )(x)
+            x = jnp.mean(x, axis=(1, 2))
+            x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+            return x
+
+    model = ResNetBN(bn_f32=bn_f32)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 1000, size=batch), dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images, train=False)
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    state = {
+        "params": variables["params"],
+        "batch_stats": variables["batch_stats"],
+        "opt_state": optimizer.init(variables["params"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_classifier_train_step(
+        model.apply, optimizer, has_batch_stats=True, donate=True
+    )
+    batch_dict = {"image": images, "label": labels}
+    t0 = time.time()
+    for _ in range(warmup):
+        state, loss = step(state, batch_dict)
+    jax.block_until_ready(loss)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(steps):
+        state, loss = step(state, batch_dict)
+    jax.block_until_ready(loss)
+    elapsed = time.time() - t0
+    ips = batch * steps / elapsed
+    print(
+        f"[sweep] batch={batch} bn_f32={bn_f32} compile={compile_s:.1f}s "
+        f"loss={float(loss):.3f} -> {ips:.1f} imgs/sec",
+        file=sys.stderr,
+        flush=True,
+    )
+    return ips
+
+
+def main():
+    results = {}
+    for batch, bn_f32 in [(256, True), (256, False), (512, False), (1024, False), (512, True)]:
+        try:
+            results[(batch, bn_f32)] = run(batch, bn_f32)
+        except Exception as e:  # noqa: BLE001
+            print(f"[sweep] batch={batch} bn_f32={bn_f32} FAILED: {e}", file=sys.stderr)
+    best = max(results, key=results.get)
+    print(f"[sweep] BEST batch={best[0]} bn_f32={best[1]} -> {results[best]:.1f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -386,9 +386,11 @@ def test_ensure_cluster_admin_binding_noops():
 def test_ensure_cluster_admin_binding_memoized_and_net_safe():
     from devspace_tpu.kube.client import KubeClient
 
-    # connection-level failure is swallowed (best-effort) and not memoized
+    # connection-level failure is swallowed (best-effort) and the attempt
+    # memoized — a dev-loop reload must not re-pay the round-trip
     transport = _RecordingTransport([OSError("unreachable")])
     client = KubeClient(transport)
+    client.ensure_cluster_admin_binding(account="a@b.c")
     client.ensure_cluster_admin_binding(account="a@b.c")
     assert [c[0] for c in transport.calls] == ["GET"]
     # success is memoized: second call issues no requests
