@@ -104,11 +104,20 @@ def run(batch: int, bn_f32: bool, steps: int = 20, warmup: int = 3) -> float:
 
 def main():
     results = {}
-    for batch, bn_f32 in [(256, True), (256, False), (512, False), (1024, False), (512, True)]:
+    import ast
+
+    raw = os.environ.get("SWEEP_VARIANTS", "[(256, True), (256, False), (512, False)]")
+    try:
+        variants = [(int(b), bool(f)) for b, f in ast.literal_eval(raw)]
+    except (ValueError, SyntaxError, TypeError) as e:
+        sys.exit(f"bad SWEEP_VARIANTS {raw!r} (want a list of (batch, bn_f32) tuples): {e}")
+    for batch, bn_f32 in variants:
         try:
             results[(batch, bn_f32)] = run(batch, bn_f32)
         except Exception as e:  # noqa: BLE001
             print(f"[sweep] batch={batch} bn_f32={bn_f32} FAILED: {e}", file=sys.stderr)
+    if not results:
+        sys.exit("[sweep] no variant succeeded")
     best = max(results, key=results.get)
     print(f"[sweep] BEST batch={best[0]} bn_f32={best[1]} -> {results[best]:.1f}", file=sys.stderr)
 

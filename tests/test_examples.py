@@ -52,4 +52,5 @@ def test_examples_present():
         "jax-mnist",
         "jax-resnet-tpu",
         "llama-inference",
+        "long-context",
     } <= names
