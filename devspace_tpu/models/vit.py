@@ -1,0 +1,103 @@
+"""Vision Transformer (ViT) in Flax — the attention-family counterpart to
+the ResNet conv benchmark.
+
+TPU-first choices mirror resnet.py: bfloat16 compute with float32 params
+and float32 LayerNorm statistics (flax upcasts internally); patchify as a
+single strided conv so the whole embed is one MXU matmul; static shapes;
+learned position embeddings (no interpolation — shapes are fixed under
+jit). No reference-counterpart (the reference ships no model code,
+SURVEY.md §2.13); API follows models/resnet.py so
+training.make_classifier_train_step works unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(dim, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, deterministic=True
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        return x + MlpBlock(mlp_dim=self.mlp_dim, dtype=self.dtype)(y)
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        p = self.patch_size
+        assert h % p == 0 and w % p == 0, "image must divide into patches"
+        x = x.astype(self.dtype)
+        # patchify = one strided conv = one big MXU matmul per image
+        x = nn.Conv(
+            self.hidden_dim,
+            (p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.hidden_dim)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.hidden_dim), jnp.float32
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.hidden_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="final_norm")(x)
+        x = x[:, 0]  # cls token
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ViT_S16 = partial(ViT, hidden_dim=384, depth=12, num_heads=6, mlp_dim=1536)
+ViT_B16 = partial(ViT, hidden_dim=768, depth=12, num_heads=12, mlp_dim=3072)
+ViT_L16 = partial(ViT, hidden_dim=1024, depth=24, num_heads=16, mlp_dim=4096)

@@ -23,15 +23,28 @@ def pipeline_apply(
     mesh: Mesh,
     stage_fn: Callable,
     axis: str = "pipe",
+    params_spec: tuple = (),
+    xs_spec: tuple = (),
 ):
     """Build ``f(stage_params, x_microbatches) -> y_microbatches``.
 
     ``stage_params``: pytree whose leaves have a leading stage dim S,
     sharded over ``axis`` (each device sees its own stage's slice).
     ``x_microbatches``: [M, mb, ...] replicated along ``axis``; returns
-    [M, mb, ...] outputs of the final stage (replicated).
+    [M, mb, ...] outputs of the final stage (replicated along ``axis``).
     ``stage_fn(params_one_stage, x) -> y`` must map activations to
     activations of the same shape (classic homogeneous-stage pipeline).
+
+    Composition with tp/dp in the same mesh: ``params_spec`` shards the
+    dims AFTER each param leaf's leading stage dim (e.g. ``("model",)``
+    keeps stage weights row-sharded inside the stages — stage_fn then
+    owns the tensor-parallel psum), and ``xs_spec`` shards the dims after
+    the microbatch dim of ``xs`` (e.g. ``("data",)`` keeps microbatches
+    data-sharded end to end). Without these, weights/activations arrive
+    replicated over those axes. ``params_spec`` may also be a pytree of
+    per-leaf tuples matching ``stage_params`` for mixed-rank leaves
+    (e.g. ``{"w": ("model",), "b": (None,)}`` so a [S, d, d] weight is
+    row-sharded while its [S, d] bias stays replicated).
     """
     n_stages = mesh.shape[axis]
 
@@ -75,11 +88,19 @@ def pipeline_apply(
         gathered = jax.lax.all_gather(outputs, axis)
         return gathered[n_stages - 1]
 
+    if isinstance(params_spec, tuple):
+        params_in_spec = P(axis, *params_spec)
+    else:  # pytree of per-leaf dim tuples (prefix pytree for shard_map)
+        params_in_spec = jax.tree_util.tree_map(
+            lambda leaf_spec: P(axis, *leaf_spec),
+            params_spec,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(params_in_spec, P(None, *xs_spec)),
+        out_specs=P(None, *xs_spec),
         check_vma=False,
     )
 

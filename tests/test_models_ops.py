@@ -296,3 +296,54 @@ def test_moe_train_step_learns():
         ces.append(float(metrics["ce"]))
     assert all(np.isfinite(ces))
     assert ces[-1] < ces[0] * 0.7, f"no learning: {ces[0]} -> {ces[-1]}"
+
+
+def test_vit_forward_tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from devspace_tpu.models.vit import ViT
+
+    model = ViT(
+        num_classes=10, patch_size=4, hidden_dim=32, depth=2, num_heads=4,
+        mlp_dim=64, dtype=jnp.float32,
+    )
+    x = jnp.ones((2, 16, 16, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+    # patch grid 4x4 + cls token
+    assert variables["params"]["pos_embed"].shape == (1, 17, 32)
+
+
+def test_vit_train_step_learns():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from devspace_tpu.models.vit import ViT
+    from devspace_tpu.training.trainer import make_classifier_train_step
+
+    model = ViT(
+        num_classes=4, patch_size=4, hidden_dim=32, depth=1, num_heads=2,
+        mlp_dim=64, dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(16, 8, 8, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 4, size=16), dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images, train=False)
+    optimizer = optax.adam(1e-2)
+    state = {
+        "params": variables["params"],
+        "opt_state": optimizer.init(variables["params"]),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_classifier_train_step(model.apply, optimizer, has_batch_stats=False)
+    batch = {"image": images, "label": labels}
+    state, loss0 = step(state, batch)
+    for _ in range(30):
+        state, loss = step(state, batch)
+    assert float(loss) < float(loss0)
