@@ -1,0 +1,94 @@
+"""XLA/TPU profiling for training workloads.
+
+The dev-loop side of observability is utils/trace.py (spans around
+build/deploy/sync). This module is its compute-side counterpart — also
+beyond-parity (the reference has no tracing at all, SURVEY.md §5.1): a
+thin, dependency-free wrapper over ``jax.profiler`` so workloads scaffolded
+by this framework capture XLA traces viewable in TensorBoard/Perfetto,
+plus device-memory introspection for OOM hunting.
+
+Usage in a train loop::
+
+    from devspace_tpu.training.profiler import profile, step_annotation
+
+    with profile(".devspace/profiles"):          # capture a window
+        for i in range(10):
+            with step_annotation(i):             # named step boundaries
+                state, loss = step_fn(state, batch)
+        jax.block_until_ready(loss)
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profile into ``log_dir`` (TensorBoard layout:
+    ``<log_dir>/plugins/profile/<run>/``). Includes device traces (what
+    actually ran on the TPU and for how long) and host traces."""
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def step_annotation(step: int, name: str = "train") -> Iterator[None]:
+    """Mark one training step in the profile (shows up as named step
+    boundaries in the trace viewer's step-time analysis)."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield
+
+
+def annotate(name: str):
+    """Named region annotation for profiles (context manager) — wrap any
+    host-side phase (data loading, checkpointing) to see it on the host
+    timeline next to the device trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Per-device HBM usage: bytes_in_use / peak_bytes_in_use / limit —
+    the first thing to look at before sharding or remat decisions. Not
+    every backend reports stats (CPU returns {})."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def memory_summary() -> str:
+    """Human-readable HBM summary across local devices."""
+    lines = []
+    for dev in jax.local_devices():
+        stats = device_memory_stats(dev)
+        if not stats:
+            lines.append(f"{dev}: no memory stats available")
+            continue
+        in_use = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        gib = 1 << 30
+        line = f"{dev}: {in_use / gib:.2f} GiB in use, peak {peak / gib:.2f} GiB"
+        if limit:
+            line += f", limit {limit / gib:.2f} GiB ({100 * in_use / limit:.0f}%)"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def save_device_profile(log_dir: str, duration_ms: int = 3000) -> str:
+    """One-shot programmatic capture helper for live debugging: profile
+    for ``duration_ms`` while the caller's async dispatch keeps running,
+    then return the log dir (point TensorBoard at it)."""
+    import time
+
+    with profile(log_dir):
+        time.sleep(duration_ms / 1000)
+    return log_dir

@@ -102,3 +102,36 @@ def test_interrupted_loop_resumes_to_same_result(tmp_path):
         np.asarray(ref_state["params"]["w"]),
         rtol=1e-6,
     )
+
+
+def test_profiler_capture_and_memory_stats(tmp_path):
+    """XLA profile capture (beyond-parity observability, SURVEY §5.1) —
+    the trace must land in TensorBoard's plugins/profile layout and the
+    memory helpers must not crash on backends without stats."""
+    import glob
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from devspace_tpu.training.profiler import (
+        annotate,
+        device_memory_stats,
+        memory_summary,
+        profile,
+        step_annotation,
+    )
+
+    log_dir = str(tmp_path / "profiles")
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    with profile(log_dir):
+        for i in range(3):
+            with step_annotation(i):
+                out = f(x)
+        with annotate("blocking"):
+            jax.block_until_ready(out)
+    produced = glob.glob(os.path.join(log_dir, "plugins", "profile", "*", "*"))
+    assert produced, "no profile artifacts written"
+    assert isinstance(device_memory_stats(), dict)
+    assert memory_summary()
