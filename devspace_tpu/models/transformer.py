@@ -168,12 +168,20 @@ def forward(
     cfg: TransformerConfig,
     attention_fn: Optional[Callable] = None,
     positions: Optional[jax.Array] = None,
+    remat: bool = False,
 ) -> jax.Array:
     """Training/prefill forward -> logits [B, T, vocab] (float32).
 
     ``attention_fn(q, k, v) -> ctx`` defaults to full causal attention;
     pass a ring_attention(...) for sequence-parallel long context — K/V
-    heads are already repeated to full head count before the call."""
+    heads are already repeated to full head count before the call.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint``: activations are
+    recomputed in the backward pass instead of stored, cutting training
+    activation memory from O(layers x T x D) to O(T x D) at ~1/3 extra
+    FLOPs — the standard trade for long-context training (pair with
+    ring attention; use ``partial(forward, remat=True)`` as the trainer's
+    forward)."""
     attn = attention_fn or partial(default_attention, causal=True)
     b, t = tokens.shape
     hd = cfg.head_dim
@@ -182,7 +190,8 @@ def forward(
         positions = jnp.arange(t)
     cos, sin = rope_frequencies(cfg, positions)
     h = params["embed"][tokens]  # [B, T, D]
-    for layer in params["layers"]:
+
+    def layer_fn(h, layer, cos, sin):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
         k = (x @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
@@ -193,7 +202,12 @@ def forward(
         h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
         gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
-        h = h + (gated @ layer["w_down"]).astype(h.dtype)
+        return h + (gated @ layer["w_down"]).astype(h.dtype)
+
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        h = layer_fn(h, layer, cos, sin)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     return (h @ params["lm_head"]).astype(jnp.float32)
 

@@ -208,14 +208,23 @@ def train_loop(
     already-consumed data upstream."""
     loss = None
     step = start_step
-    for batch in batches:
-        state, loss = step_fn(state, batch)
-        step += 1
-        if log_every and logger and step % log_every == 0:
-            scalar = loss["loss"] if isinstance(loss, dict) else loss
-            logger.info("[train] step %d loss %.4f", step, float(scalar))
-        if checkpoint_manager is not None:
-            checkpoint_manager.maybe_save(step, state)
+    try:
+        for batch in batches:
+            state, loss = step_fn(state, batch)
+            step += 1
+            if log_every and logger and step % log_every == 0:
+                scalar = loss["loss"] if isinstance(loss, dict) else loss
+                logger.info("[train] step %d loss %.4f", step, float(scalar))
+            if checkpoint_manager is not None:
+                checkpoint_manager.maybe_save(step, state)
+    finally:
+        # Async saves must commit even when step_fn/the iterator raises —
+        # otherwise the error exit loses the last "saved" checkpoint that
+        # the sync path would have made durable.
+        if checkpoint_manager is not None and hasattr(
+            checkpoint_manager, "wait_until_finished"
+        ):
+            checkpoint_manager.wait_until_finished()
     return state, loss
 
 

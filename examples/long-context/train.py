@@ -13,6 +13,7 @@ edit the config below and the train loop hot-reloads on all workers.
 
 import os
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -68,7 +69,9 @@ def main():
     }
     attention = ring_attention(mesh, axis="seq", causal=True, batch_axis="data")
     step_fn = make_lm_train_step(
-        tfm.forward,
+        # remat: recompute layer activations in backward — at 32k tokens
+        # the stored-activation footprint would dominate HBM otherwise
+        partial(tfm.forward, remat=True),
         CFG,
         optimizer,
         mesh=mesh,

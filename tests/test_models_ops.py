@@ -347,3 +347,37 @@ def test_vit_train_step_learns():
     for _ in range(30):
         state, loss = step(state, batch)
     assert float(loss) < float(loss0)
+
+
+def test_transformer_remat_matches_plain():
+    """remat=True must change memory behavior only: identical logits and
+    identical gradients (jax.checkpoint recomputes, never approximates)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from devspace_tpu.models import transformer as tfm
+
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+
+    logits_plain = tfm.forward(params, tokens, cfg)
+    logits_remat = tfm.forward(params, tokens, cfg, remat=True)
+    np.testing.assert_allclose(
+        np.asarray(logits_plain), np.asarray(logits_remat), rtol=1e-5, atol=1e-5
+    )
+
+    def loss(p, remat):
+        return jnp.mean(tfm.forward(p, tokens, cfg, remat=remat) ** 2)
+
+    g_plain = jax.grad(partial(loss, remat=False))(params)
+    g_remat = jax.grad(partial(loss, remat=True))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        g_plain,
+        g_remat,
+    )

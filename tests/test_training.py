@@ -135,3 +135,54 @@ def test_profiler_capture_and_memory_stats(tmp_path):
     assert produced, "no profile artifacts written"
     assert isinstance(device_memory_stats(), dict)
     assert memory_summary()
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """Async saves must commit durably (wait_until_finished) and restore
+    to the exact same pytree as the sync path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from devspace_tpu.training.checkpoint import CheckpointManager
+
+    state = {
+        "params": {"w": jnp.arange(8.0).reshape(2, 4)},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval=1, use_async=True)
+    mgr.save(1, state)
+    mgr.save(2, jax.tree_util.tree_map(lambda x: x + 1, state))
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1, 2]
+    restored = mgr.restore(2, template=jax.eval_shape(lambda: state))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(8.0).reshape(2, 4) + 1
+    )
+    assert int(restored["step"]) == 8
+    # restore() without an explicit wait must also be safe mid-flight
+    mgr.save(3, state)
+    restored3 = mgr.restore(3, template=jax.eval_shape(lambda: state))
+    assert int(restored3["step"]) == 7
+
+
+def test_async_checkpoint_restore_or_init_and_close(tmp_path):
+    """restore_or_init must see an in-flight async save (no cold-init
+    window) and close() must be idempotent."""
+    import jax
+    import jax.numpy as jnp
+
+    from devspace_tpu.training.checkpoint import CheckpointManager
+
+    state = {"w": jnp.ones((4,)), "step": jnp.asarray(1, jnp.int32)}
+    with CheckpointManager(
+        str(tmp_path / "ckpt"), save_interval=1, use_async=True
+    ) as mgr:
+        mgr.save(5, state)
+        # immediately query — the save may still be in flight
+        restored, step = mgr.restore_or_init(
+            lambda: jax.tree_util.tree_map(jnp.zeros_like, state)
+        )
+        assert step == 5
+        assert float(restored["w"][0]) == 1.0
+    mgr.close()  # idempotent after context exit
