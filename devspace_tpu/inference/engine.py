@@ -1,0 +1,285 @@
+"""Continuous-batching inference engine (iteration-level scheduling).
+
+The serving-side counterpart of the training stack — no reference
+counterpart (the reference ships no model code, SURVEY.md §2.13); this is
+what turns the llama-inference example from a one-request-at-a-time server
+into a throughput engine.
+
+Design, TPU-first:
+- **Static shapes throughout**: the KV cache is preallocated at
+  ``[layers, max_slots, max_len, kv_heads, head_dim]`` and every decode
+  iteration runs ONE jitted step over all slots — empty slots just compute
+  masked garbage (their cost is already paid; admission fills them). No
+  recompilation ever happens during serving.
+- **Iteration-level scheduling** (the Orca/vLLM insight): new requests are
+  admitted between decode iterations, not between requests, so a long
+  generation does not block a short one — per-slot positions make every
+  slot's causal mask independent.
+- **Bucketed prefill**: prompts are padded to power-of-two buckets and
+  prefit via a scanned decode on a single-slot cache, then scattered into
+  the engine cache — a handful of compilations total, amortized across
+  the process lifetime.
+
+Greedy and per-request-temperature sampling; optional EOS early stop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer as tfm
+
+
+@dataclass
+class Request:
+    prompt_ids: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    # filled by the engine
+    tokens: list[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[str] = None
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error:
+            raise RuntimeError(self.error)
+        return self.tokens
+
+
+class _Slot:
+    __slots__ = ("req", "length", "remaining", "last_token", "key")
+
+    def __init__(self):
+        self.req: Optional[Request] = None
+
+
+class InferenceEngine:
+    """Continuous-batching engine over ``max_slots`` concurrent sequences.
+
+    ``submit()`` is thread-safe and returns the Request whose ``result()``
+    blocks until generation completes. ``start()`` spawns the scheduler
+    thread; ``stop()`` drains and joins it."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: tfm.TransformerConfig,
+        max_slots: int = 8,
+        max_len: Optional[int] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len or cfg.max_seq_len
+        L, Hkv, D = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self._fresh_cache = lambda: {
+            "k": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
+            "v": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
+        }
+        self.cache = self._fresh_cache()
+        self.slots = [_Slot() for _ in range(max_slots)]
+        self.pending: queue.Queue[Request] = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # The per-slot decode core lives with the model (single source of
+        # truth for the layer math): models.transformer.decode_tokens.
+        # Donating the cache is what keeps this viable at scale — an
+        # undonated update would copy the multi-GB K/V buffers per token.
+        self._decode = jax.jit(
+            lambda params, cache, tokens, positions: tfm.decode_tokens(
+                params, cache, tokens, positions, cfg
+            ),
+            donate_argnums=1,
+        )
+
+        def prefill(params, prompt):  # prompt [1, T_bucket]
+            cache = tfm.init_kv_cache(self.cfg, 1, self.max_len)
+
+            def step(cache, tok):
+                logits, cache = tfm.decode_step(params, cache, tok[:, None], self.cfg)
+                return cache, logits
+
+            cache, logits = jax.lax.scan(step, cache, jnp.moveaxis(prompt, 1, 0))
+            return cache, logits  # logits [T_bucket, 1, vocab]
+
+        # jit's own shape-keyed cache compiles once per prompt bucket
+        self._prefill = jax.jit(prefill)
+
+        def insert(cache, k1, v1, slot_idx):
+            # Write one prefilled sequence's K/V bucket into its slot, in
+            # place (donated). k1/v1: [L, bucket, Hkv, D]. Writing the pad
+            # tail too is safe: positions >= the true prompt length are
+            # overwritten by decode before the mask ever exposes them.
+            # slot_idx stays dynamic -> one compile per prompt bucket, not
+            # per (slot, length) pair.
+            return {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], k1[:, None], (0, slot_idx, 0, 0, 0)
+                ),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], v1[:, None], (0, slot_idx, 0, 0, 0)
+                ),
+            }
+
+        self._insert = jax.jit(insert, donate_argnums=0)
+
+    # -- public api --------------------------------------------------------
+    def submit(
+        self,
+        prompt_ids: list[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Request:
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_ids) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({len(prompt_ids)}+{max_new_tokens}) "
+                f"exceeds max_len {self.max_len}"
+            )
+        req = Request(list(prompt_ids), int(max_new_tokens), temperature, eos_id, seed)
+        self.pending.put(req)
+        return req
+
+    def start(self) -> "InferenceEngine":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the scheduler and fail out any unfinished requests so no
+        caller blocks forever on a dead engine."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+        self._fail_outstanding("engine stopped")
+
+    # -- scheduler ---------------------------------------------------------
+    def _fail_outstanding(self, reason: str) -> None:
+        for slot in self.slots:
+            if slot.req is not None:
+                slot.req.error = reason
+                slot.req.done.set()
+                slot.req = None
+        while True:
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                break
+            req.error = reason
+            req.done.set()
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _admit(self, slot_idx: int, req: Request) -> None:
+        slot = self.slots[slot_idx]
+        t = len(req.prompt_ids)
+        bucket = self._bucket(t)
+        prompt = jnp.asarray(
+            [req.prompt_ids + [0] * (bucket - t)], dtype=jnp.int32
+        )
+        cache1, logits = self._prefill(self.params, prompt)
+        self.cache = self._insert(
+            self.cache,
+            cache1["k"][:, 0, :bucket],
+            cache1["v"][:, 0, :bucket],
+            jnp.asarray(slot_idx, jnp.int32),
+        )
+        slot.req = req
+        slot.length = t
+        slot.remaining = req.max_new_tokens
+        slot.key = jax.random.PRNGKey(req.seed)
+        # first generated token comes from the last REAL prompt position
+        first = self._sample(slot, logits[t - 1, 0])
+        self._emit(slot_idx, int(first))
+
+    def _sample(self, slot: _Slot, logits: jax.Array):
+        if slot.req.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        slot.key, sub = jax.random.split(slot.key)
+        return jax.random.categorical(sub, logits / slot.req.temperature)
+
+    def _emit(self, slot_idx: int, token: int) -> None:
+        slot = self.slots[slot_idx]
+        req = slot.req
+        req.tokens.append(token)
+        slot.last_token = token
+        slot.length += 1
+        slot.remaining -= 1
+        if slot.remaining <= 0 or (
+            req.eos_id is not None and token == req.eos_id
+        ):
+            req.done.set()
+            slot.req = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # admit as many pending requests as there are free slots
+            for i, slot in enumerate(self.slots):
+                if slot.req is not None:
+                    continue
+                try:
+                    req = self.pending.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    self._admit(i, req)
+                except Exception as e:  # noqa: BLE001 — surface per-request
+                    req.error = str(e)
+                    req.done.set()
+                    self.slots[i].req = None
+            active = [i for i, s in enumerate(self.slots) if s.req is not None]
+            if not active:
+                try:
+                    req = self.pending.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                self.pending.put(req)
+                continue
+            tokens = jnp.asarray(
+                [
+                    (s.last_token if s.req is not None else 0)
+                    for s in self.slots
+                ],
+                dtype=jnp.int32,
+            )
+            positions = jnp.asarray(
+                [
+                    (s.length - 1 if s.req is not None else 0)
+                    for s in self.slots
+                ],
+                dtype=jnp.int32,
+            )
+            # NOTE positions hold the index of the last emitted token: its
+            # K/V has not been written yet (prefill wrote only the prompt),
+            # so the decode step both writes it and attends through it.
+            try:
+                logits, self.cache = self._decode(
+                    self.params, self.cache, tokens, positions
+                )
+                for i in active:
+                    self._emit(i, int(self._sample(self.slots[i], logits[i])))
+            except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
+                # The cache was donated into the failed call and may be
+                # invalid; fail everything in flight rather than hang
+                # every caller, then rebuild a clean cache and keep
+                # serving new requests.
+                self._fail_outstanding(f"decode failed: {e}")
+                self.cache = self._fresh_cache()  # donated buffer is gone

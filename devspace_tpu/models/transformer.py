@@ -223,36 +223,51 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: Optional[int] = N
     }
 
 
-def decode_step(
+def decode_tokens(
     params: dict,
-    cache: dict,
-    tokens: jax.Array,  # [B, 1] next token ids
+    cache: dict,  # needs "k"/"v" [L, B, T, Hkv, D]; "length" unused here
+    tokens: jax.Array,  # [B] int32 last token per sequence
+    positions: jax.Array,  # [B] int32 write position per sequence
     cfg: TransformerConfig,
 ) -> tuple[jax.Array, dict]:
-    """One incremental decode step -> (logits [B, vocab], new cache).
-    Static shapes: the cache is preallocated at max_len and masked by
-    position, so the whole loop jits once (no dynamic shapes on TPU)."""
+    """One decode iteration with PER-SEQUENCE positions -> (logits
+    [B, vocab], {"k","v"} updated stacks).
+
+    The general core shared by ``decode_step`` (all sequences at the same
+    depth — a constant positions vector) and the continuous-batching
+    engine (``inference/engine.py`` — every slot at its own depth). RoPE
+    angles, the KV scatter and the causal mask are all indexed by
+    ``positions``. Static shapes: the cache is preallocated at max_len and
+    masked by position, so the whole decode loop jits once."""
     b = tokens.shape[0]
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
-    pos = cache["length"]
     max_len = cache["k"].shape[2]
-    cos, sin = rope_frequencies(cfg, pos[None])
-    h = params["embed"][tokens[:, 0]][:, None, :]  # [B, 1, D]
+    half = hd // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)  # [B, half]
+
+    def rope1(x):  # [B, 1, H, D] rotated at each sequence's own position
+        x1, x2 = x[..., :half], x[..., half:]
+        c = cos[:, None, None, :]
+        s = sin[:, None, None, :]
+        o1 = x1.astype(jnp.float32) * c - x2.astype(jnp.float32) * s
+        o2 = x2.astype(jnp.float32) * c + x1.astype(jnp.float32) * s
+        return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+
+    batch_idx = jnp.arange(b)
+    h = params["embed"][tokens][:, None, :]  # [B, 1, D]
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(b, 1, cfg.n_heads, hd)
         k = (x @ layer["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
         v = (x @ layer["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache["k"][li], k, (0, pos, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            cache["v"][li], v, (0, pos, 0, 0)
-        )
+        q = rope1(q)
+        k = rope1(k)
+        k_cache = cache["k"][li].at[batch_idx, positions].set(k[:, 0])
+        v_cache = cache["v"][li].at[batch_idx, positions].set(v[:, 0])
         new_k.append(k_cache)
         new_v.append(v_cache)
         keys = repeat_kv(k_cache, n_rep)  # [B, L, H, D]
@@ -260,7 +275,9 @@ def decode_step(
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / jnp.sqrt(hd).astype(jnp.float32)
-        mask = (jnp.arange(max_len) <= pos)[None, None, None, :]
+        mask = (jnp.arange(max_len)[None, :] <= positions[:, None])[
+            :, None, None, :
+        ]
         scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vals).astype(h.dtype)
@@ -270,12 +287,23 @@ def decode_step(
         h = h + (gated @ layer["w_down"]).astype(h.dtype)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    new_cache = {
-        "k": jnp.stack(new_k),
-        "v": jnp.stack(new_v),
-        "length": pos + 1,
-    }
-    return logits, new_cache
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def decode_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, 1] next token ids
+    cfg: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """One incremental decode step -> (logits [B, vocab], new cache).
+    All sequences advance in lockstep at ``cache["length"]`` — the
+    constant-positions specialization of ``decode_tokens``."""
+    b = tokens.shape[0]
+    pos = cache["length"]
+    positions = jnp.full((b,), pos, jnp.int32)
+    logits, kv = decode_tokens(params, cache, tokens[:, 0], positions, cfg)
+    return logits, {"k": kv["k"], "v": kv["v"], "length": pos + 1}
 
 
 def generate(

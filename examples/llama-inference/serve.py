@@ -2,18 +2,20 @@
 TPU pod, reached through `devspace-tpu dev`'s port-forward and health-checked
 by `devspace-tpu analyze`.
 
-Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N}) and
-/healthz. Defaults to the TINY config so it runs anywhere; set
-MODEL=llama2-7b on a real TPU pod with weights mounted.
+Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
+optional "temperature", "eos_id"}) and /healthz. Concurrent requests are
+continuously batched by devspace_tpu.inference.InferenceEngine
+(iteration-level scheduling — a long generation never blocks a short one).
+Defaults to the TINY config so it runs anywhere; set MODEL=llama2-7b on a
+real TPU pod with weights mounted.
 """
 
 import json
 import os
-import threading
 
 import jax
-import jax.numpy as jnp
 
+from devspace_tpu.inference import InferenceEngine
 from devspace_tpu.models import transformer as tfm
 
 CONFIGS = {"tiny": tfm.TINY, "llama2-7b": tfm.LLAMA2_7B, "llama2-13b": tfm.LLAMA2_13B}
@@ -27,16 +29,18 @@ class Server:
         # Real deployments restore from a checkpoint
         # (devspace_tpu.training.checkpoint); random weights keep the
         # example self-contained.
-        self.params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
-        self.lock = threading.Lock()
+        params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
+        self.engine = InferenceEngine(
+            params,
+            self.cfg,
+            max_slots=int(os.environ.get("MAX_SLOTS", 8)),
+        ).start()
 
-    def generate(self, prompt_ids, max_new_tokens):
-        prompt = jnp.asarray([prompt_ids], dtype=jnp.int32)
-        with self.lock:
-            out = tfm.generate(
-                self.params, prompt, self.cfg, max_new_tokens=max_new_tokens
-            )
-        return [int(t) for t in out[0]]
+    def generate(self, prompt_ids, max_new_tokens, temperature=0.0, eos_id=None):
+        req = self.engine.submit(
+            prompt_ids, max_new_tokens, temperature=temperature, eos_id=eos_id
+        )
+        return req.result(timeout=600)
 
 
 def main():
@@ -69,7 +73,12 @@ def main():
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length))
                 tokens = server.generate(
-                    req["prompt_ids"], int(req.get("max_new_tokens", 16))
+                    req["prompt_ids"],
+                    int(req.get("max_new_tokens", 16)),
+                    temperature=float(req.get("temperature", 0.0)),
+                    eos_id=(
+                        int(req["eos_id"]) if req.get("eos_id") is not None else None
+                    ),
                 )
                 self._json(200, {"tokens": tokens})
             except Exception as e:  # noqa: BLE001
