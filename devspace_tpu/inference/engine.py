@@ -19,6 +19,15 @@ Design, TPU-first:
   prefit via a scanned decode on a single-slot cache, then scattered into
   the engine cache — a handful of compilations total, amortized across
   the process lifetime.
+- **Device-side sampling + chunked decode**: sampling (greedy or
+  per-slot temperature) happens inside the jitted step, and up to
+  ``chunk_max`` tokens are decoded per dispatch via ``lax.scan`` — one
+  host round-trip per chunk instead of per token. On a remote/tunneled
+  accelerator the round-trip dominates single-token decode, so this is
+  the difference between RTT-bound and compute-bound serving. A slot
+  that hits EOS mid-chunk wastes at most chunk_max-1 speculative tokens
+  (truncated host-side; the cache-write-ahead is safe — every position
+  is rewritten in the same step that first attends to it).
 
 Greedy and per-request-temperature sampling; optional EOS early stop.
 """
@@ -57,7 +66,7 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ("req", "length", "remaining", "last_token", "key")
+    __slots__ = ("req", "length", "remaining", "last_token")
 
     def __init__(self):
         self.req: Optional[Request] = None
@@ -76,35 +85,104 @@ class InferenceEngine:
         cfg: tfm.TransformerConfig,
         max_slots: int = 8,
         max_len: Optional[int] = None,
+        mesh=None,
+        model_axis: str = "model",
+        chunk_max: int = 8,
     ):
+        """``mesh`` turns on tensor-parallel serving: params are placed per
+        ``models.transformer.param_partition_spec`` and the KV cache is
+        sharded over its head dim on ``model_axis`` (requires
+        ``n_kv_heads % mesh.shape[model_axis] == 0``); the decode jit then
+        runs under GSPMD, which inserts the attention/FFN collectives.
+        Scheduling is unchanged — TP is invisible to the slot machinery."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq_len
+        self.mesh = mesh
         L, Hkv, D = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        self._fresh_cache = lambda: {
-            "k": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
-            "v": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
-        }
+        cache_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if Hkv % mesh.shape[model_axis]:
+                raise ValueError(
+                    f"n_kv_heads {Hkv} not divisible by mesh axis "
+                    f"'{model_axis}' ({mesh.shape[model_axis]})"
+                )
+            cache_sharding = NamedSharding(
+                mesh, P(None, None, None, model_axis, None)
+            )
+            self.params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params,
+                tfm.param_partition_spec(cfg, model_axis=model_axis),
+            )
+
+        def fresh_cache():
+            cache = {
+                "k": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
+                "v": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
+            }
+            if cache_sharding is not None:
+                cache = {
+                    k: jax.device_put(v, cache_sharding)
+                    for k, v in cache.items()
+                }
+            return cache
+
+        self._fresh_cache = fresh_cache
         self.cache = self._fresh_cache()
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
         self._stop = threading.Event()
+        # serializes submit's check+put against stop's set+drain, closing
+        # the window where a request lands in the queue after the drain
+        self._submit_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
         # The per-slot decode core lives with the model (single source of
         # truth for the layer math): models.transformer.decode_tokens.
         # Donating the cache is what keeps this viable at scale — an
         # undonated update would copy the multi-GB K/V buffers per token.
-        self._decode = jax.jit(
-            lambda params, cache, tokens, positions: tfm.decode_tokens(
-                params, cache, tokens, positions, cfg
-            ),
-            donate_argnums=1,
-        )
+        # Sampling runs on device and n_steps tokens are decoded per
+        # dispatch (lax.scan), so the host pays one round-trip per chunk.
+        self.chunk_max = max(1, int(chunk_max))
+        self._keys = jnp.zeros((max_slots, 2), jnp.uint32)
+
+        def decode_chunk(params, cache, tokens, positions, temps, keys, n_steps):
+            def step(carry, _):
+                cache, tok, pos, keys = carry
+                logits, cache = tfm.decode_tokens(params, cache, tok, pos, cfg)
+                split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                keys, subs = split[:, 0], split[:, 1]
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                sampled = jax.vmap(
+                    lambda k, l, t: jax.random.categorical(
+                        k, l / jnp.maximum(t, 1e-6)
+                    )
+                )(subs, logits, temps).astype(jnp.int32)
+                tok = jnp.where(temps > 0, sampled, greedy)
+                return (cache, tok, pos + 1, keys), tok
+
+            (cache, _, _, keys), toks = jax.lax.scan(
+                step, (cache, tokens, positions, keys), None, length=n_steps
+            )
+            return cache, keys, toks  # toks [n_steps, B]
+
+        # one compile per chunk size; chunk sizes are clamped to powers of
+        # two <= chunk_max so the set stays tiny
+        from functools import partial as _partial
+
+        self._decode_chunk = {
+            k: jax.jit(_partial(decode_chunk, n_steps=k), donate_argnums=1)
+            for k in self._chunk_sizes()
+        }
 
         def prefill(params, prompt):  # prompt [1, T_bucket]
-            cache = tfm.init_kv_cache(self.cfg, 1, self.max_len)
+            # Cache sized to the bucket, not max_len: prefill attention is
+            # O(bucket^2) and jit is shape-keyed per bucket anyway.
+            cache = tfm.init_kv_cache(self.cfg, 1, prompt.shape[1])
 
             def step(cache, tok):
                 logits, cache = tfm.decode_step(params, cache, tok[:, None], self.cfg)
@@ -145,13 +223,18 @@ class InferenceEngine:
     ) -> Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         if len(prompt_ids) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt+generation ({len(prompt_ids)}+{max_new_tokens}) "
                 f"exceeds max_len {self.max_len}"
             )
         req = Request(list(prompt_ids), int(max_new_tokens), temperature, eos_id, seed)
-        self.pending.put(req)
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            self.pending.put(req)
         return req
 
     def start(self) -> "InferenceEngine":
@@ -162,10 +245,12 @@ class InferenceEngine:
     def stop(self) -> None:
         """Stop the scheduler and fail out any unfinished requests so no
         caller blocks forever on a dead engine."""
-        self._stop.set()
+        with self._submit_lock:
+            self._stop.set()
         if self._thread:
             self._thread.join(timeout=30)
-        self._fail_outstanding("engine stopped")
+        with self._submit_lock:
+            self._fail_outstanding("engine stopped")
 
     # -- scheduler ---------------------------------------------------------
     def _fail_outstanding(self, reason: str) -> None:
@@ -188,6 +273,20 @@ class InferenceEngine:
             b *= 2
         return min(b, self.max_len)
 
+    def _chunk_sizes(self) -> list[int]:
+        sizes = [1]
+        while sizes[-1] * 2 <= self.chunk_max:
+            sizes.append(sizes[-1] * 2)
+        return sizes
+
+    def _pick_chunk(self, n: int) -> int:
+        """Largest compiled chunk size <= n."""
+        best = 1
+        for k in self._decode_chunk:
+            if best < k <= n:
+                best = k
+        return best
+
     def _admit(self, slot_idx: int, req: Request) -> None:
         slot = self.slots[slot_idx]
         t = len(req.prompt_ids)
@@ -205,16 +304,17 @@ class InferenceEngine:
         slot.req = req
         slot.length = t
         slot.remaining = req.max_new_tokens
-        slot.key = jax.random.PRNGKey(req.seed)
+        key = jax.random.PRNGKey(req.seed)
+        key, sub = jax.random.split(key)
+        self._keys = self._keys.at[slot_idx].set(key)
         # first generated token comes from the last REAL prompt position
-        first = self._sample(slot, logits[t - 1, 0])
+        first = self._sample(req, sub, logits[t - 1, 0])
         self._emit(slot_idx, int(first))
 
-    def _sample(self, slot: _Slot, logits: jax.Array):
-        if slot.req.temperature <= 0.0:
+    def _sample(self, req: Request, key, logits: jax.Array):
+        if req.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        slot.key, sub = jax.random.split(slot.key)
-        return jax.random.categorical(sub, logits / slot.req.temperature)
+        return jax.random.categorical(key, logits / req.temperature)
 
     def _emit(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
@@ -247,11 +347,18 @@ class InferenceEngine:
                     self.slots[i].req = None
             active = [i for i, s in enumerate(self.slots) if s.req is not None]
             if not active:
+                # idle: block for the next request and admit it directly
+                # (re-enqueuing would push it behind later arrivals)
                 try:
                     req = self.pending.get(timeout=0.05)
                 except queue.Empty:
                     continue
-                self.pending.put(req)
+                try:
+                    self._admit(0, req)
+                except Exception as e:  # noqa: BLE001
+                    req.error = str(e)
+                    req.done.set()
+                    self.slots[0].req = None
                 continue
             tokens = jnp.asarray(
                 [
@@ -267,15 +374,40 @@ class InferenceEngine:
                 ],
                 dtype=jnp.int32,
             )
+            temps = jnp.asarray(
+                [
+                    (s.req.temperature if s.req is not None else 0.0)
+                    for s in self.slots
+                ],
+                dtype=jnp.float32,
+            )
+            # Chunk size: sized to the LONGEST remaining want (rounded
+            # down to a compiled power of two) — clamping to the shortest
+            # would put the whole batch back in the one-round-trip-per-
+            # token regime whenever any short request is co-resident.
+            # Slots that finish mid-chunk (EOS or remaining=0) truncate
+            # host-side; the overshoot compute is already paid by the
+            # static batch. Only the max_len write bound is a hard clamp.
+            want = max(s.remaining for s in self.slots if s.req is not None)
+            room = min(
+                self.max_len - s.length
+                for s in self.slots
+                if s.req is not None
+            )
+            k_steps = self._pick_chunk(max(1, min(want, room + 1)))
             # NOTE positions hold the index of the last emitted token: its
             # K/V has not been written yet (prefill wrote only the prompt),
             # so the decode step both writes it and attends through it.
             try:
-                logits, self.cache = self._decode(
-                    self.params, self.cache, tokens, positions
+                self.cache, self._keys, toks = self._decode_chunk[k_steps](
+                    self.params, self.cache, tokens, positions, temps, self._keys
                 )
+                toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
                 for i in active:
-                    self._emit(i, int(self._sample(self.slots[i], logits[i])))
+                    for j in range(k_steps):
+                        if self.slots[i].req is None:
+                            break  # finished mid-chunk; rest is speculative
+                        self._emit(i, int(toks[j, i]))
             except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
                 # The cache was donated into the failed call and may be
                 # invalid; fail everything in flight rather than hang

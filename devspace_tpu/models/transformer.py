@@ -121,12 +121,21 @@ def rope_frequencies(cfg: TransformerConfig, positions):
     return jnp.cos(angles), jnp.sin(angles)
 
 
-def apply_rope(x, cos, sin):
-    """x [B, T, H, D]; rotate pairs (split-halves convention)."""
+def apply_rope(x, cos, sin, per_batch: bool = False):
+    """x [B, T, H, D]; rotate pairs (split-halves convention).
+
+    ``cos``/``sin`` are [T, half] broadcast over batch (default — the
+    prefill/forward case where every sequence shares positions), or with
+    ``per_batch=True`` [B, half] broadcast over T=1 (the per-slot decode
+    case where every sequence sits at its own position)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if per_batch:
+        cos = cos[:, None, None, :]
+        sin = sin[:, None, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     out1 = x32_1 * cos - x32_2 * sin
     out2 = x32_2 * cos + x32_1 * sin
@@ -243,18 +252,10 @@ def decode_tokens(
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
     max_len = cache["k"].shape[2]
-    half = hd // 2
-    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos, sin = jnp.cos(angles), jnp.sin(angles)  # [B, half]
+    cos, sin = rope_frequencies(cfg, positions)  # [B, half]
 
     def rope1(x):  # [B, 1, H, D] rotated at each sequence's own position
-        x1, x2 = x[..., :half], x[..., half:]
-        c = cos[:, None, None, :]
-        s = sin[:, None, None, :]
-        o1 = x1.astype(jnp.float32) * c - x2.astype(jnp.float32) * s
-        o2 = x2.astype(jnp.float32) * c + x1.astype(jnp.float32) * s
-        return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+        return apply_rope(x, cos, sin, per_batch=True)
 
     batch_idx = jnp.arange(b)
     h = params["embed"][tokens][:, None, :]  # [B, 1, D]

@@ -34,6 +34,7 @@ class Server:
             params,
             self.cfg,
             max_slots=int(os.environ.get("MAX_SLOTS", 8)),
+            chunk_max=int(os.environ.get("CHUNK_MAX", 8)),
         ).start()
 
     def generate(self, prompt_ids, max_new_tokens, temperature=0.0, eos_id=None):

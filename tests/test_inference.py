@@ -79,3 +79,42 @@ def test_engine_temperature_sampling_stays_in_vocab(params):
         engine.stop()
     assert len(toks) == 12
     assert all(0 <= t < CFG.vocab_size for t in toks)
+
+
+def test_engine_tensor_parallel_matches_reference(params):
+    """TP serving (mesh over the model axis): GSPMD-sharded decode must be
+    output-equivalent to the single-device engine and to standalone
+    generate (greedy)."""
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, mesh=mesh
+    ).start()
+    try:
+        reqs = [([5, 1, 4], 7), ([2, 2, 2, 2, 2], 5)]
+        handles = [engine.submit(p, n) for p, n in reqs]
+        results = [h.result(timeout=120) for h in handles]
+    finally:
+        engine.stop()
+    for (prompt, n), got in zip(reqs, results):
+        assert got == reference_generate(params, prompt, n)
+
+
+def test_engine_rejects_indivisible_tp(params):
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"model": 4}, devices=jax.devices()[:4])
+    # TINY has n_kv_heads=2, not divisible by 4
+    with pytest.raises(ValueError):
+        InferenceEngine(params, CFG, max_slots=2, max_len=64, mesh=mesh)
+
+
+def test_engine_submit_validation_and_stopped(params):
+    engine = InferenceEngine(params, CFG, max_slots=1, max_len=32)
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], 0)  # generate would return []; engine requires >=1
+    engine.start()
+    engine.stop()
+    with pytest.raises(RuntimeError):
+        engine.submit([1, 2], 2)
