@@ -170,13 +170,26 @@ def cmd_reset(args) -> int:
 
 # -- session commands -------------------------------------------------------
 def cmd_enter(args) -> int:
-    """Reference: cmd/enter.go — shell into a slice worker."""
-    from ..services.sessions import start_terminal
+    """Reference: cmd/enter.go — shell into a slice worker; --all runs the
+    command on every worker with prefixed output (slice generalization)."""
+    from ..services.sessions import broadcast_exec, start_terminal
 
     ctx = Context(args)
     command = args.command if args.command else None
+    if getattr(args, "all", False):
+        if args.worker is not None:
+            ctx.log.error("[enter] --all and --worker are mutually exclusive")
+            return 1
+        if not command:
+            ctx.log.error("[enter] --all requires a command (no interactive fan-out TTY)")
+            return 1
+        return broadcast_exec(ctx.backend, ctx.config, command, logger=ctx.log)
     return start_terminal(
-        ctx.backend, ctx.config, command=command, worker_index=args.worker, logger=ctx.log
+        ctx.backend,
+        ctx.config,
+        command=command,
+        worker_index=args.worker if args.worker is not None else 0,
+        logger=ctx.log,
     )
 
 
@@ -946,7 +959,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_deploy)
 
     sp = sub.add_parser("enter", help="open a shell in a slice worker")
-    sp.add_argument("--worker", "-w", type=int, default=0, help="worker index")
+    sp.add_argument(
+        "--worker", "-w", type=int, default=None, help="worker index (default 0)"
+    )
+    sp.add_argument(
+        "--all",
+        action="store_true",
+        help="run the command on EVERY worker, output prefixed per worker",
+    )
     sp.add_argument("command", nargs="*", help="command to run instead of a shell")
     sp.set_defaults(fn=cmd_enter)
 

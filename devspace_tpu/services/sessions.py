@@ -125,6 +125,14 @@ def start_port_forwarding(
     return forwarders
 
 
+def worker_prefix(pod) -> str:
+    """One prefix convention for all slice-fan-out output (`logs`,
+    `enter --all`): `[worker-N]` when the pod carries a TPU worker id,
+    else the pod name."""
+    wid = getattr(pod, "tpu_worker_id", None)
+    return f"[worker-{wid}] " if wid is not None else f"[{getattr(pod, 'name', pod)}] "
+
+
 class LogMux:
     """Worker-prefixed log streaming across the slice
     (replaces the reference's single-pod log follow)."""
@@ -151,8 +159,7 @@ class LogMux:
         self._write_lock = threading.Lock()
 
     def _prefix(self, pod) -> str:
-        wid = pod.tpu_worker_id
-        return f"[worker-{wid}] " if wid is not None else f"[{pod.name}] "
+        return worker_prefix(pod)
 
     def run_once(self) -> None:
         """Print the last `tail` lines of every worker (no follow)."""
@@ -355,3 +362,49 @@ class _EmptyStdin:
 
     def read(self, n):
         return b""
+
+
+def broadcast_exec(
+    backend,
+    config,
+    command: list[str],
+    selector_name=None,
+    timeout: float = 300.0,
+    logger=None,
+) -> int:
+    """Run ``command`` on EVERY slice worker concurrently, with worker-
+    prefixed output (the N-worker generalization of `enter -- <cmd>`;
+    SURVEY §7 hard part #3 — terminal UX across N workers). Returns the
+    first non-zero exit code, else 0."""
+    import concurrent.futures
+
+    log = logger or logutil.get_logger()
+    workers, ns, container = resolve_workers(
+        backend, config, selector_name=selector_name, timeout=60.0
+    )
+
+    def run(w):
+        return backend.exec_buffered(
+            w, command, namespace=ns, container=container, timeout=timeout
+        )
+
+    rc = 0
+    with concurrent.futures.ThreadPoolExecutor(max_workers=len(workers)) as pool:
+        futures = {pool.submit(run, w): w for w in workers}
+        for fut in concurrent.futures.as_completed(futures):
+            w = futures[fut]
+            prefix = worker_prefix(w)
+            try:
+                out, err, code = fut.result()
+            except Exception as e:  # noqa: BLE001 — report per worker
+                log.error("%sexec failed: %s", prefix, e)
+                rc = rc or 1
+                continue
+            for line in out.decode(errors="replace").splitlines():
+                print(f"{prefix}{line}")
+            for line in err.decode(errors="replace").splitlines():
+                print(f"{prefix}{line}", file=sys.stderr)
+            if code:
+                log.error("%sexit code %d", prefix, code)
+                rc = rc or code
+    return rc

@@ -63,9 +63,9 @@ def main():
         chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
     ).start()
     try:
-        # warmup/compile wave — wait for it so the timed wave starts with
-        # all slots free and every bucket compiled
-        for h in [engine.submit(p, 4) for p in prompts]:
+        # warmup/compile wave at FULL length — short warmups would leave
+        # the larger chunk kernels to compile inside the timed window
+        for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
             h.result(timeout=600)
         t0 = time.time()
         handles = [engine.submit(p, NEW_TOKENS) for p in prompts]

@@ -196,3 +196,19 @@ def test_install_update_path(tmp_path, monkeypatch):
     assert rc.count("added by devspace-tpu") == (tmp_path / ".bashrc").read_text().count(
         "added by devspace-tpu"
     )
+
+
+def test_enter_all_broadcasts(project, tmp_path, capsys):
+    """enter --all runs the command on every slice worker with
+    worker-prefixed output and propagates non-zero exits."""
+    from devspace_tpu.cli.main import main
+
+    assert main(["init"]) == 0
+    assert main(["deploy"]) == 0
+    rc = main(["enter", "--all", "--", "sh", "-c", "echo hello-$TPU_WORKER_ID"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hello-" in out and out.count("hello-") >= 1
+    assert main(["enter", "--all", "--", "sh", "-c", "exit 3"]) == 3
+    # --all without a command is an error
+    assert main(["enter", "--all"]) == 1
