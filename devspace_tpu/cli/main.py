@@ -184,12 +184,10 @@ def cmd_enter(args) -> int:
             ctx.log.error("[enter] --all requires a command (no interactive fan-out TTY)")
             return 1
         return broadcast_exec(ctx.backend, ctx.config, command, logger=ctx.log)
+    # None falls through to the dev.terminal.worker config (precedence
+    # args > config > 0, resolved in start_terminal)
     return start_terminal(
-        ctx.backend,
-        ctx.config,
-        command=command,
-        worker_index=args.worker if args.worker is not None else 0,
-        logger=ctx.log,
+        ctx.backend, ctx.config, command=command, worker_index=args.worker, logger=ctx.log
     )
 
 

@@ -368,19 +368,26 @@ def broadcast_exec(
     backend,
     config,
     command: list[str],
-    selector_name=None,
     timeout: float = 300.0,
     logger=None,
 ) -> int:
     """Run ``command`` on EVERY slice worker concurrently, with worker-
     prefixed output (the N-worker generalization of `enter -- <cmd>`;
-    SURVEY §7 hard part #3 — terminal UX across N workers). Returns the
-    first non-zero exit code, else 0."""
+    SURVEY §7 hard part #3 — terminal UX across N workers). Targets the
+    same pods/container as ``start_terminal`` (dev.terminal config).
+    Returns the first non-zero exit code, else 0."""
     import concurrent.futures
 
     log = logger or logutil.get_logger()
+    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
     workers, ns, container = resolve_workers(
-        backend, config, selector_name=selector_name, timeout=60.0
+        backend,
+        config,
+        tc.selector,
+        tc.label_selector,
+        tc.namespace,
+        tc.container_name,
+        timeout=POD_WAIT_TERMINAL if not config.tpu else POD_WAIT_SYNC,
     )
 
     def run(w):

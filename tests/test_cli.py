@@ -203,12 +203,18 @@ def test_enter_all_broadcasts(project, tmp_path, capsys):
     worker-prefixed output and propagates non-zero exits."""
     from devspace_tpu.cli.main import main
 
+    from devspace_tpu.kube.fake import FakeCluster
+
     assert main(["init"]) == 0
     assert main(["deploy"]) == 0
+    # the command must reach EVERY deployed worker, not just one
+    fc = FakeCluster(os.environ["DEVSPACE_FAKE_BACKEND"], persist=True)
+    n_workers = len(fc.list_pods())
+    assert n_workers >= 1
     rc = main(["enter", "--all", "--", "sh", "-c", "echo hello-$TPU_WORKER_ID"])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "hello-" in out and out.count("hello-") >= 1
+    assert out.count("hello-") == n_workers
     assert main(["enter", "--all", "--", "sh", "-c", "exit 3"]) == 3
     # --all without a command is an error
     assert main(["enter", "--all"]) == 1
