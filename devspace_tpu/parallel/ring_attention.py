@@ -38,17 +38,44 @@ def _block_attend(q, k, v, q_offset, kv_offset, scale, causal):
     return scores
 
 
+def _online_update(acc, row_max, row_sum, scores, v_blk):
+    """One flash-style online-softmax accumulation step (shared by the
+    ring hop and the within-hop kv sub-blocking)."""
+    blk_max = jnp.max(scores, axis=-1)
+    new_max = jnp.maximum(row_max, blk_max)
+    # Guard fully-masked rows (new_max = -inf) against NaNs.
+    safe_max = jnp.where(new_max <= NEG_INF / 2, 0.0, new_max)
+    correction = jnp.exp(row_max - safe_max)
+    correction = jnp.where(row_max <= NEG_INF / 2, 0.0, correction)
+    probs = jnp.exp(scores - safe_max[..., None])
+    probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
+    acc = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", probs, v_blk, preferred_element_type=jnp.float32
+    )
+    row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+    return acc, new_max, row_sum
+
+
 def ring_attention(
     mesh: Mesh,
     axis: str = "seq",
     causal: bool = True,
     batch_axis: Optional[str] = None,
     head_axis: Optional[str] = None,
+    block_size: Optional[int] = 512,
 ):
     """Build ``f(q, k, v) -> out`` with q/k/v [B, T, H, D] sharded on T
     over ``axis``; out is sharded the same way. ``batch_axis``/``head_axis``
     optionally co-shard B and H (composing sequence parallelism with data
-    and tensor parallelism in one mesh)."""
+    and tensor parallelism in one mesh).
+
+    ``block_size`` bounds the within-hop working set (flash-within-ring):
+    each arriving K/V block is consumed in kv sub-blocks of this size with
+    the same online-softmax accumulators, so the materialized score tile
+    is [B, H, t_local, block_size] instead of [B, H, t_local, t_local] —
+    at 32k tokens over an 8-ring that is the difference between a
+    512-wide tile and a 4k×4k (~1 GiB f32 per hop) intermediate. ``None``
+    disables sub-blocking."""
     ring = mesh.shape[axis]
     io_spec = P(batch_axis, axis, head_axis, None)
 
@@ -57,6 +84,9 @@ def ring_attention(
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
         t_local = q.shape[1]
         q_offset = idx * t_local
+        blk = block_size if block_size and block_size < t_local else None
+        if blk is not None and t_local % blk:
+            blk = None  # uneven tail: fall back to whole-block attend
 
         b, tq, h, d = q.shape
         acc = jnp.zeros((b, h, tq, d), jnp.float32)
@@ -67,20 +97,37 @@ def ring_attention(
             k_blk, v_blk, acc, row_max, row_sum = carry
             kv_idx = (idx - step_idx) % ring  # whose block we hold now
             kv_offset = kv_idx * t_local
-            scores = _block_attend(q, k_blk, v_blk, q_offset, kv_offset, scale, causal)
-            blk_max = jnp.max(scores, axis=-1)
-            new_max = jnp.maximum(row_max, blk_max)
-            # Guard fully-masked rows (new_max = -inf) against NaNs.
-            safe_max = jnp.where(new_max <= NEG_INF / 2, 0.0, new_max)
-            correction = jnp.exp(row_max - safe_max)
-            correction = jnp.where(row_max <= NEG_INF / 2, 0.0, correction)
-            probs = jnp.exp(scores - safe_max[..., None])
-            probs = jnp.where(scores <= NEG_INF / 2, 0.0, probs)
-            acc = acc * correction[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", probs, v_blk, preferred_element_type=jnp.float32
-            )
-            row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-            row_max = new_max
+            if blk is None:
+                scores = _block_attend(
+                    q, k_blk, v_blk, q_offset, kv_offset, scale, causal
+                )
+                acc, row_max, row_sum = _online_update(
+                    acc, row_max, row_sum, scores, v_blk
+                )
+            else:
+                # flash-within-ring: consume this hop's K/V in sub-blocks
+                n_sub = t_local // blk
+                k_sub = k_blk.reshape(b, n_sub, blk, h, d)
+                v_sub = v_blk.reshape(b, n_sub, blk, h, d)
+
+                def sub_step(carry, sub):
+                    acc, row_max, row_sum = carry
+                    k_s, v_s, sub_idx = sub
+                    scores = _block_attend(
+                        q, k_s, v_s, q_offset, kv_offset + sub_idx * blk,
+                        scale, causal,
+                    )
+                    return _online_update(acc, row_max, row_sum, scores, v_s), None
+
+                (acc, row_max, row_sum), _ = jax.lax.scan(
+                    sub_step,
+                    (acc, row_max, row_sum),
+                    (
+                        jnp.moveaxis(k_sub, 1, 0),
+                        jnp.moveaxis(v_sub, 1, 0),
+                        jnp.arange(n_sub),
+                    ),
+                )
             # Rotate K/V to the next device; ICI-neighbor transfer.
             perm = [(i, (i + 1) % ring) for i in range(ring)]
             k_next = jax.lax.ppermute(k_blk, axis, perm)

@@ -125,6 +125,25 @@ def start_port_forwarding(
     return forwarders
 
 
+def _resolve_terminal_workers(backend, config, timeout: Optional[float] = None):
+    """Shared terminal-target resolution (terminal, attach, enter --all):
+    dev.terminal config decides selector/namespace/container; one site so
+    the three commands can never target different pods."""
+    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
+    if timeout is None:
+        timeout = POD_WAIT_TERMINAL if not config.tpu else POD_WAIT_SYNC
+    workers, ns, container = resolve_workers(
+        backend,
+        config,
+        tc.selector,
+        tc.label_selector,
+        tc.namespace,
+        tc.container_name,
+        timeout=timeout,
+    )
+    return tc, workers, ns, container
+
+
 def worker_prefix(pod) -> str:
     """One prefix convention for all slice-fan-out output (`logs`,
     `enter --all`): `[worker-N]` when the pod carries a TPU worker id,
@@ -216,16 +235,7 @@ def start_terminal(
     services/terminal.go StartTerminal; command precedence args > config >
     ``sh -c "bash || sh"``, terminal.go:29-33). Returns the exit code."""
     log = logger or logutil.get_logger()
-    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
-    workers, ns, container = resolve_workers(
-        backend,
-        config,
-        tc.selector,
-        tc.label_selector,
-        tc.namespace,
-        tc.container_name,
-        timeout=POD_WAIT_TERMINAL if not config.tpu else POD_WAIT_SYNC,
-    )
+    tc, workers, ns, container = _resolve_terminal_workers(backend, config)
     idx = worker_index if worker_index is not None else (tc.worker or 0)
     idx = max(0, min(idx, len(workers) - 1))
     pod = workers[idx]
@@ -338,15 +348,8 @@ def start_attach(
 ) -> int:
     """Attach to a worker's main process (reference: services/attach.go —
     the fallback when the terminal is disabled)."""
-    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
-    workers, ns, container = resolve_workers(
-        backend,
-        config,
-        tc.selector,
-        tc.label_selector,
-        tc.namespace,
-        tc.container_name,
-        timeout=POD_WAIT_ATTACH,
+    _, workers, ns, container = _resolve_terminal_workers(
+        backend, config, timeout=POD_WAIT_ATTACH
     )
     pod = workers[max(0, min(worker_index, len(workers) - 1))]
     proc = backend.attach_stream(pod, container=container)
@@ -379,16 +382,7 @@ def broadcast_exec(
     import concurrent.futures
 
     log = logger or logutil.get_logger()
-    tc = (config.dev.terminal if config.dev else None) or latest.TerminalConfig()
-    workers, ns, container = resolve_workers(
-        backend,
-        config,
-        tc.selector,
-        tc.label_selector,
-        tc.namespace,
-        tc.container_name,
-        timeout=POD_WAIT_TERMINAL if not config.tpu else POD_WAIT_SYNC,
-    )
+    _, workers, ns, container = _resolve_terminal_workers(backend, config)
 
     def run(w):
         return backend.exec_buffered(

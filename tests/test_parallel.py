@@ -390,3 +390,26 @@ def test_3d_parallel_dp_tp_pp_composition():
     for p in stage_params:
         ref = jax.vmap(lambda x, p=p: stage_fn_dense(p, x))(ref)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_subblocked_matches_full(causal):
+    """flash-within-ring: kv sub-blocking inside each hop must be exactly
+    equivalent to the whole-block hop (same online-softmax math)."""
+    from devspace_tpu.parallel.ring_attention import full_attention, ring_attention
+
+    mesh = create_mesh({"seq": 4}, devices=jax.devices()[:4])
+    b, t, h, d = 2, 32, 4, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d))
+    ref = full_attention(q, k, v, causal=causal)
+    # t_local = 8; sub-block at 4 -> 2 sub-steps per hop
+    ring = ring_attention(mesh, axis="seq", causal=causal, block_size=4)
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # uneven block size falls back to whole-block and still matches
+    ring_odd = ring_attention(mesh, axis="seq", causal=causal, block_size=3)
+    np.testing.assert_allclose(
+        np.asarray(ring_odd(q, k, v)), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
