@@ -243,6 +243,9 @@ class KanikoBuilder:
     def _write_remote_file(self, pod, path: str, content: str) -> None:
         import shlex
 
+        # identity on a real cluster; maps into the pod dir on the fake
+        # backend (same convention as the sync engine's remote dirs)
+        path = self.backend.translate_path(pod, path)
         out, err, rc = self.backend.exec_buffered(
             pod,
             [

@@ -86,7 +86,25 @@ def ring_attention(
         q_offset = idx * t_local
         blk = block_size if block_size and block_size < t_local else None
         if blk is not None and t_local % blk:
-            blk = None  # uneven tail: fall back to whole-block attend
+            # Degrade gracefully to the largest divisor of t_local so the
+            # memory bound holds instead of cliffing to a whole-block
+            # [t_local, t_local] tile; warn if only a degenerate divisor
+            # exists (tiny blocks = long scan, so fall back instead).
+            d = blk
+            while t_local % d:
+                d -= 1
+            if d >= max(16, blk // 4):
+                blk = d
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"ring_attention: t_local={t_local} has no usable "
+                    f"divisor near block_size={blk}; falling back to a "
+                    f"whole-block [{t_local},{t_local}] score tile",
+                    stacklevel=2,
+                )
+                blk = None
 
         b, tq, h, d = q.shape
         acc = jnp.zeros((b, h, tq, d), jnp.float32)

@@ -278,3 +278,71 @@ def test_init_registries_uses_docker_config(tmp_path, monkeypatch):
     assert created == ["devspace-auth-gcr-io"]
     assert fc.get_object("v1", "Secret", "devspace-auth-gcr-io", "default")
     assert fc.get_object("v1", "Secret", "devspace-auth-gcr-io", "other")
+
+
+def test_kaniko_builder_on_fake_cluster(tmp_path, monkeypatch):
+    """In-cluster kaniko build orchestration against the fake backend:
+    pod spawn + context upload (sync one-shot) + entrypoint-override
+    Dockerfile rewrite + executor invocation + pod cleanup
+    (reference behavior: builder/kaniko/kaniko.go:84-255)."""
+    from devspace_tpu.builder.builders import BuildError, KanikoBuilder
+    from devspace_tpu.kube.fake import FakeCluster
+    from devspace_tpu.utils.fsutil import write_file
+
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    ctx = tmp_path / "ctx"
+    write_file(str(ctx / "Dockerfile"), "FROM scratch\nENTRYPOINT [\"app\"]\n")
+    write_file(str(ctx / "src" / "main.py"), "print('hi')\n")
+
+    seen = {}
+    real_exec = fc.exec_stream
+
+    def exec_stream(pod, command, **kw):
+        if command and command[0] == "/kaniko/executor":
+            seen["args"] = command
+            # inspect the pod fs WHILE the pod is alive (deleted after)
+            ctx_arg = next(a for a in command if a.startswith("--context="))
+            ctx_dir = fc.translate_path(pod, ctx_arg.split("=", 1)[1])
+            seen["uploaded"] = sorted(
+                os.path.relpath(os.path.join(dp, f), ctx_dir)
+                for dp, _, fns in os.walk(ctx_dir)
+                for f in fns
+            )
+            with open(os.path.join(ctx_dir, "Dockerfile")) as fh:
+                seen["dockerfile"] = fh.read()
+            return real_exec(pod, ["sh", "-c", "echo pushed"], **kw)
+        return real_exec(pod, command, **kw)
+
+    monkeypatch.setattr(fc, "exec_stream", exec_stream)
+    builder = KanikoBuilder(fc, namespace="default")
+    builder.build(
+        "registry.local/app",
+        "t1",
+        str(ctx),
+        str(ctx / "Dockerfile"),
+        entrypoint_override=["sleep", "inf"],
+        build_args={"FOO": "bar"},
+    )
+    assert "--destination=registry.local/app:t1" in seen["args"]
+    assert "--build-arg=FOO=bar" in seen["args"]
+    assert "Dockerfile" in seen["uploaded"]
+    assert os.path.join("src", "main.py") in seen["uploaded"]
+    # entrypoint override rewrote the remote Dockerfile, not the local one
+    assert "sleep" in seen["dockerfile"]
+    assert "sleep" not in (ctx / "Dockerfile").read_text()
+    # the build pod is cleaned up
+    assert fc.list_pods(namespace="default") == []
+
+    # failure path: non-zero executor exit surfaces as BuildError and the
+    # pod is still deleted
+    def exec_fail(pod, command, **kw):
+        if command and command[0] == "/kaniko/executor":
+            return real_exec(pod, ["sh", "-c", "echo boom >&2; exit 3"], **kw)
+        return real_exec(pod, command, **kw)
+
+    monkeypatch.setattr(fc, "exec_stream", exec_fail)
+    with pytest.raises(BuildError, match="rc=3"):
+        builder.build(
+            "registry.local/app", "t2", str(ctx), str(ctx / "Dockerfile")
+        )
+    assert fc.list_pods(namespace="default") == []

@@ -413,3 +413,23 @@ def test_ring_attention_subblocked_matches_full(causal):
     np.testing.assert_allclose(
         np.asarray(ring_odd(q, k, v)), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def test_ring_attention_blocksize_degrades_to_divisor():
+    """A block_size that doesn't divide t_local must degrade to a nearby
+    divisor (memory bound preserved), still matching full attention."""
+    from devspace_tpu.parallel.ring_attention import full_attention, ring_attention
+
+    mesh = create_mesh({"seq": 2}, devices=jax.devices()[:2])
+    # t_local = 96; block_size 40 degrades to divisor 32 (>= 16 floor)
+    b, t, h, d = 1, 192, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d))
+    ref = full_attention(q, k, v, causal=True)
+    import warnings as W
+
+    with W.catch_warnings():
+        W.simplefilter("error")  # divisor path must NOT warn
+        out = ring_attention(mesh, axis="seq", causal=True, block_size=40)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
