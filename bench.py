@@ -81,6 +81,40 @@ def bench_resnet50() -> tuple[float, str]:
     return imgs_per_sec, platform
 
 
+def _wait_mirrored(
+    backend,
+    workers,
+    filename: str,
+    content: str | None = None,
+    session=None,
+    container_path: str = "/app",
+    timeout: float = 60.0,
+) -> None:
+    """Poll until ``filename`` (optionally with exact ``content``) exists on
+    EVERY worker; raise on session failure or deadline so a sync fault can
+    never wedge the bench (it must always print its one JSON line)."""
+    import os
+
+    deadline = time.monotonic() + timeout
+    while True:
+        if session is not None and session.error is not None:
+            raise RuntimeError(f"sync session failed: {session.error}")
+        ok = True
+        for w in workers:
+            p = os.path.join(backend.translate_path(w, container_path), filename)
+            if not os.path.exists(p):
+                ok = False
+                break
+            if content is not None and open(p).read() != content:
+                ok = False
+                break
+        if ok:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"{filename} not mirrored within {timeout}s")
+        time.sleep(0.005)
+
+
 def bench_sync_latency() -> float:
     """Median edit->all-workers latency on a 4-worker fake slice (seconds).
     The dev-loop half of the product; compared against the reference's
@@ -112,18 +146,82 @@ def bench_sync_latency() -> float:
             write_file(path, marker)
             fut = time.time() + 2 + trial
             os.utime(path, (fut, fut))
-            while not all(
-                os.path.exists(os.path.join(fc.translate_path(w, "/app"), "train.py"))
-                and open(os.path.join(fc.translate_path(w, "/app"), "train.py")).read()
-                == marker
-                for w in workers
-            ):
-                time.sleep(0.005)
+            _wait_mirrored(
+                fc, workers, "train.py", content=marker, session=session
+            )
             lat.append(time.monotonic() - t0)
     finally:
         session.stop()
     lat.sort()
     return lat[len(lat) // 2]
+
+
+def bench_dev_loop() -> float:
+    """Cold `devspace-tpu dev` end-to-end latency on the fake backend:
+    init -> build -> deploy -> all services (sync fan-out + watcher) live
+    and a first edit mirrored to every worker. This is north-star metric
+    #1's framework-side half (on real TPU the remainder is container image
+    pull + jax compile, which the CLI does not control). Seconds."""
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from devspace_tpu.cli.main import main as cli_main
+    from devspace_tpu.utils import log as logutil
+    from devspace_tpu.utils.fsutil import write_file
+
+    tmp = tempfile.mkdtemp()
+    proj = os.path.join(tmp, "proj")
+    os.makedirs(proj)
+    cwd = os.getcwd()
+    env_before = {
+        k: os.environ.get(k)
+        for k in ("DEVSPACE_FAKE_BACKEND", "DEVSPACE_NONINTERACTIVE")
+    }
+    os.environ["DEVSPACE_FAKE_BACKEND"] = os.path.join(tmp, "cluster")
+    os.environ["DEVSPACE_NONINTERACTIVE"] = "1"
+    logutil.set_logger(logutil.DiscardLogger())
+    try:
+        os.chdir(proj)
+        write_file("train.py", "import jax\nprint('step 0')\n")
+        t0 = time.monotonic()
+        if cli_main(["init"]) != 0:
+            raise RuntimeError("devspace init failed")
+        if cli_main(["deploy"]) != 0:
+            raise RuntimeError("devspace deploy failed")
+        # services half: sync sessions up + first edit on every worker
+        import argparse
+
+        from devspace_tpu.cli.context import Context
+        from devspace_tpu.services.sessions import start_sync
+
+        ctx = Context(
+            argparse.Namespace(
+                namespace=None, kube_context=None, config=None, debug=False
+            )
+        )
+        sessions = start_sync(ctx.backend, ctx.config, base_dir=ctx.root)
+        try:
+            write_file("edited.py", "x = 1\n")
+            _wait_mirrored(
+                ctx.backend,
+                sessions[0].workers,
+                "edited.py",
+                session=sessions[0],
+            )
+            return time.monotonic() - t0
+        finally:
+            for s in sessions:
+                s.stop()
+    finally:
+        os.chdir(cwd)
+        for k, v in env_before.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def run_resnet_isolated() -> tuple[float, str]:
@@ -200,6 +298,14 @@ def main() -> int:
         log(f"[bench] sync edit->4-workers median latency {sync_latency * 1000:.0f}ms")
     except Exception as e:  # noqa: BLE001
         log(f"[bench] sync latency bench failed: {e}")
+    try:
+        dev_s = bench_dev_loop()
+        log(
+            f"[bench] cold dev loop (init->deploy->sync live->first edit "
+            f"mirrored) {dev_s:.2f}s on the fake slice"
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"[bench] dev loop bench failed: {e}")
     try:
         imgs_per_sec, platform = run_resnet_isolated()
     except Exception as e:  # noqa: BLE001
