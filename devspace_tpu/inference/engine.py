@@ -105,6 +105,19 @@ class InferenceEngine:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from .quantization import QuantizedLinear
+
+            if any(
+                isinstance(leaf, QuantizedLinear)
+                for leaf in jax.tree_util.tree_leaves(
+                    params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+                )
+            ):
+                raise ValueError(
+                    "tensor-parallel serving does not yet compose with "
+                    "int8-quantized params — pass dense params with mesh, "
+                    "or quantized params without"
+                )
             if Hkv % mesh.shape[model_axis]:
                 raise ValueError(
                     f"n_kv_heads {Hkv} not divisible by mesh axis "

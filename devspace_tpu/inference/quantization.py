@@ -1,0 +1,144 @@
+"""Weight-only int8 quantization for serving.
+
+Per-output-channel symmetric int8: each matmul weight [D_in, D_out] is
+stored as int8 with a float32 scale per output column; the matmul
+dequantizes on the fly (``x @ w_q * scale``), halving (vs bf16) or
+quartering (vs f32) weight HBM traffic — decode is weight-bandwidth-bound,
+so this translates ~directly into tokens/sec on HBM-limited configs.
+Activations stay in the model dtype; no calibration needed for
+weight-only. No reference counterpart (SURVEY.md §2.13: the reference
+ships no model code).
+
+Usage::
+
+    from devspace_tpu.inference.quantization import quantize_params
+    q_params = quantize_params(params)           # transformer param tree
+    engine = InferenceEngine(q_params, cfg, ...) # drop-in: decode_tokens
+                                                 # sees QuantizedLinear
+                                                 # leaves transparently
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# transformer matmul leaves worth quantizing (norms/embeddings stay f32 —
+# embeddings are gathers, not matmuls, and norms are tiny)
+_MATMUL_LEAVES = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedLinear:
+    """int8 weight + per-output-channel f32 scale; behaves like the dense
+    weight under ``@`` (dequantizing matmul)."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q  # int8 [D_in, D_out]
+        self.scale = scale  # f32 [D_out]
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # what the dense weight would have been
+        return jnp.bfloat16
+
+    def __rmatmul__(self, x):
+        # x @ w: do the contraction in the input dtype's MXU-friendly
+        # form; int8 weights are upcast lane-wise by XLA, the scale is a
+        # cheap per-column multiply on the [.., D_out] result.
+        y = jax.lax.dot_general(
+            x,
+            self.q,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * self.scale).astype(x.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QuantizedLinear(shape={tuple(self.q.shape)})"
+
+
+def quantize_weight(w: jax.Array) -> QuantizedLinear:
+    """Symmetric per-output-channel int8 quantization of a [D_in, D_out]
+    (or [D_in, ...]) weight; scale chosen so max|w| per column maps to
+    127."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=0)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedLinear(q, scale)
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every matmul weight in a transformer param tree (see
+    ``models.transformer.init_params`` for the layout); other leaves pass
+    through untouched."""
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v, name) for v in node)
+        if name in _MATMUL_LEAVES and getattr(node, "ndim", 0) == 2:
+            return quantize_weight(node)
+        return node
+
+    return walk(params)
+
+
+def dequantize_params(params: dict):
+    """Inverse (for checkpointing or debugging): expand QuantizedLinear
+    leaves back to bf16 dense weights."""
+
+    def leaf(x):
+        if isinstance(x, QuantizedLinear):
+            return (x.q.astype(jnp.float32) * x.scale).astype(jnp.bfloat16)
+        return x
+
+    return jax.tree_util.tree_map(
+        leaf, params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+    )
+
+
+def quantization_error(params: dict) -> float:
+    """Max relative per-leaf reconstruction error across quantized leaves
+    (sanity metric; ~<1% for normal-ish weights)."""
+    errs = []
+
+    def walk(node, name=""):
+        if isinstance(node, QuantizedLinear):
+            raise ValueError(
+                "quantization_error needs the DENSE params (the original "
+                "weights are gone from a quantized tree, so the error "
+                "cannot be measured from it)"
+            )
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, k)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v, name)
+        elif name in _MATMUL_LEAVES and getattr(node, "ndim", 0) == 2:
+            ql = quantize_weight(node)
+            w = node.astype(jnp.float32)
+            deq = ql.q.astype(jnp.float32) * ql.scale
+            errs.append(
+                float(
+                    jnp.linalg.norm(w - deq) / jnp.maximum(jnp.linalg.norm(w), 1e-9)
+                )
+            )
+
+    walk(params)
+    return max(errs) if errs else 0.0

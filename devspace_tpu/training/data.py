@@ -120,3 +120,29 @@ def host_shard(batch, process_index: int | None = None, process_count: int | Non
         return x[pi * per : (pi + 1) * per]
 
     return jax.tree_util.tree_map(slice_leaf, batch)
+
+
+def from_torch(loader) -> Iterator:
+    """Adapt a ``torch.utils.data.DataLoader`` (or any iterable yielding
+    torch tensors / tuples / dicts of them) to this framework's iterator
+    contract: pytrees of numpy arrays, ready for ``host_shard`` +
+    ``prefetch_to_device``. Torch stays on CPU — it is the loading/augment
+    layer; JAX owns the devices.
+
+    Example::
+
+        loader = DataLoader(dataset, batch_size=global_bs, num_workers=8)
+        batches = prefetch_to_device(
+            (host_shard(b) for b in from_torch(loader)), sharding=sharding
+        )
+    """
+
+    def to_numpy(x):
+        if hasattr(x, "detach"):  # torch.Tensor without importing torch
+            return x.detach().cpu().numpy()
+        return np.asarray(x)
+
+    for batch in loader:
+        # tree_map handles dicts, (named)tuples, lists and any nesting —
+        # exactly the shapes torch's default_collate produces
+        yield jax.tree_util.tree_map(to_numpy, batch)

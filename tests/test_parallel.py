@@ -433,3 +433,37 @@ def test_ring_attention_blocksize_degrades_to_divisor():
         W.simplefilter("error")  # divisor path must NOT warn
         out = ring_attention(mesh, axis="seq", causal=True, block_size=40)(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_from_torch_bridge():
+    """torch DataLoader -> numpy pytree iterator -> device prefetch."""
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from devspace_tpu.training.data import from_torch, prefetch_to_device
+
+    xs = torch.arange(32, dtype=torch.float32).reshape(8, 4)
+    ys = torch.arange(8)
+    loader = DataLoader(TensorDataset(xs, ys), batch_size=4, shuffle=False)
+    batches = list(from_torch(loader))
+    assert len(batches) == 2
+    x0, y0 = batches[0]
+    assert isinstance(x0, np.ndarray) and x0.shape == (4, 4)
+    np.testing.assert_array_equal(y0, [0, 1, 2, 3])
+    # composes with device prefetch
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert jnp.asarray(out[1][0]).shape == (4, 4)
+
+
+def test_from_torch_handles_namedtuples_and_nesting():
+    import collections
+
+    import torch
+
+    from devspace_tpu.training.data import from_torch
+
+    Pt = collections.namedtuple("Pt", ["x", "y"])
+    batches = [Pt(torch.ones(2, 3), torch.zeros(2)), {"a": {"img": torch.ones(4)}}]
+    out = list(from_torch(batches))
+    assert isinstance(out[0], Pt) and isinstance(out[0].x, np.ndarray)
+    assert isinstance(out[1]["a"]["img"], np.ndarray)
