@@ -34,6 +34,11 @@ NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", 64))
 
 def main():
     params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+    if os.environ.get("BENCH_QUANT") == "1":
+        from devspace_tpu.inference.quantization import quantize_params
+
+        params = quantize_params(params)
+        print("[inf-bench] serving int8 weight-only quantized params", file=sys.stderr)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 1000, size=rng.integers(4, 32))) for _ in range(N_REQ)]
     total_new = N_REQ * NEW_TOKENS

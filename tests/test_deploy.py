@@ -67,6 +67,10 @@ def test_render_tpu_chart_multihost():
     assert node_sel["cloud.google.com/gke-tpu-topology"] == "4x4"
     svc = by_kind["Service"]
     assert svc["spec"]["clusterIP"] is None or svc["spec"]["clusterIP"] == "None"
+    # slice atomicity: voluntary disruptions must not break the slice
+    pdb = by_kind["PodDisruptionBudget"]
+    assert pdb["spec"]["maxUnavailable"] == 0
+    assert pdb["spec"]["selector"]["matchLabels"]["app"] == "trainer"
     # release label stamped on everything
     assert all(
         m["metadata"]["labels"]["devspace.tpu/release"] == "trainer"
