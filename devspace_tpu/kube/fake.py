@@ -42,6 +42,7 @@ class FakeCluster:
         self._lock = threading.RLock()
         self.pods: dict[tuple[str, str], dict] = {}
         self.objects: dict[tuple[str, str, str], dict] = {}  # (kind, ns, name)
+        self._events: list[dict] = []
         self.namespaces: set[str] = {"default"}
         self.pod_logs: dict[tuple[str, str], list[bytes]] = {}
         self.pod_ports: dict[tuple[str, str, int], int] = {}  # remote -> local
@@ -471,7 +472,40 @@ class FakeCluster:
             self.pods.pop((ns, name), None)
         self._save_state()
 
+    def add_event(
+        self,
+        message: str,
+        reason: str = "FailedScheduling",
+        type: str = "Warning",
+        involved: str = "Pod/w-0",
+        namespace: str = "default",
+        count: int = 1,
+    ) -> None:
+        """Record a synthetic cluster event (for analyze tests)."""
+        kind, _, name = involved.partition("/")
+        with self._lock:
+            self._events.append(
+                {
+                    "type": type,
+                    "reason": reason,
+                    "message": message,
+                    "count": count,
+                    "involvedObject": {
+                        "kind": kind,
+                        "name": name,
+                        "namespace": namespace,
+                    },
+                    "metadata": {"namespace": namespace},
+                }
+            )
+
     def list_events(
         self, namespace: Optional[str] = None, field_selector: Optional[str] = None
     ) -> list[dict]:
-        return []
+        # None means the default namespace, matching the real client
+        # (kube/client.py list_events: ns = namespace or default_namespace)
+        ns = namespace or self.default_namespace
+        with self._lock:
+            return [
+                e for e in self._events if e["metadata"]["namespace"] == ns
+            ]

@@ -1,0 +1,87 @@
+"""Diagnosis subsystem tests (reference strategy: analyze/ checks events,
+pod states, restarts — SURVEY §2.9 — plus the TPU slice preflight)."""
+
+from devspace_tpu.analyze.analyze import (
+    analyze_events,
+    analyze_pods,
+    analyze_tpu_slice,
+    create_report,
+)
+from devspace_tpu.config import latest
+from devspace_tpu.kube.fake import FakeCluster
+
+
+def _config(workers=2):
+    cfg = latest.new()
+    cfg.tpu = latest.TPUConfig(workers=workers)
+    cfg.deployments = [latest.DeploymentConfig(name="app")]
+    return cfg
+
+
+def test_analyze_pods_flags_bad_states_and_restarts(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("good", worker_id=0)
+    fc.add_pod("stuck", phase="Pending")
+    restarty = fc.add_pod("restarty", worker_id=1)
+    fc.pods[("default", restarty.name)]["status"]["containerStatuses"][0][
+        "restartCount"
+    ] = 3
+    problems = analyze_pods(fc, "default", wait=False)
+    text = "\n".join(problems)
+    assert "stuck" in text and "Pending" in text
+    assert "restarty" in text and "3 container restart" in text
+    assert "good" not in text
+
+
+def test_analyze_events_groups_abnormal(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    fc.add_event("0/3 nodes available", involved="Pod/app-0", count=4)
+    fc.add_event("pulled image", type="Normal", involved="Pod/app-0")
+    fc.add_event("OOMKilled", reason="Killing", involved="Pod/app-1")
+    problems = analyze_events(fc, "default")
+    text = "\n".join(problems)
+    assert "0/3 nodes available" in text
+    assert "OOMKilled" in text
+    assert "pulled image" not in text  # Normal events are not problems
+
+
+def test_analyze_tpu_slice_checks(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    # only 1 of 2 workers, and it has no TPU_WORKER_ID
+    fc.add_pod("app-0", labels={"app": "app"})
+    problems = analyze_tpu_slice(fc, _config(workers=2), "default")
+    text = "\n".join(problems)
+    assert "1/2 workers Running" in text
+    assert "missing TPU_WORKER_ID" in text
+
+    # healthy slice: both workers with distinct ids -> no problems
+    fc2 = FakeCluster(str(tmp_path / "c2"))
+    fc2.add_pod("app-0", labels={"app": "app"}, worker_id=0)
+    fc2.add_pod("app-1", labels={"app": "app"}, worker_id=1)
+    assert analyze_tpu_slice(fc2, _config(workers=2), "default") == []
+
+    # duplicate worker ids are a distinct failure mode
+    fc3 = FakeCluster(str(tmp_path / "c3"))
+    fc3.add_pod("app-0", labels={"app": "app"}, worker_id=0)
+    fc3.add_pod("app-1", labels={"app": "app"}, worker_id=0)
+    text3 = "\n".join(analyze_tpu_slice(fc3, _config(workers=2), "default"))
+    assert "duplicate TPU_WORKER_ID" in text3
+
+
+def test_create_report_renders_sections(tmp_path):
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("app-0", labels={"app": "app"}, worker_id=0)
+    fc.add_pod("broken", phase="Failed")
+    fc.add_event("node pressure", involved="Pod/broken")
+    report = create_report(fc, "default", config=_config(workers=2), wait=False)
+    assert "Analysis of namespace 'default'" in report
+    assert "Pods" in report and "broken" in report
+    assert "Events" in report and "node pressure" in report
+    assert "TPU slice" in report and "1/2 workers" in report
+
+    # a healthy namespace reports no problems
+    fc2 = FakeCluster(str(tmp_path / "ok"))
+    fc2.add_pod("app-0", labels={"app": "app"}, worker_id=0)
+    cfg = _config(workers=1)
+    report2 = create_report(fc2, "default", config=cfg, wait=False)
+    assert "No problems found" in report2
