@@ -23,16 +23,19 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_resnet50() -> tuple[float, str]:
-    import os
-
+def resnet_train_throughput(
+    stem: str = "space_to_depth",
+    batch: int = 256,
+    image: int = 224,
+    steps: int = 20,
+    warmup: int = 3,
+    dtype=None,
+    quiet: bool = False,
+) -> float:
+    """Shared ResNet-50 training-throughput harness (imgs/sec) — used by
+    the headline bench below and by scripts/bench_stem.py so A/B numbers
+    can never diverge from the headline methodology."""
     import jax
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # The image's sitecustomize pre-imports jax and freezes the
-        # platform default at interpreter startup — the env var alone is
-        # too late (same workaround as tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -40,17 +43,8 @@ def bench_resnet50() -> tuple[float, str]:
     from devspace_tpu.models.resnet import ResNet50
     from devspace_tpu.training.trainer import make_classifier_train_step
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform in ("tpu", "axon")
-    if on_tpu:
-        batch, image, steps, warmup = 256, 224, 20, 3
-        dtype = jnp.bfloat16
-    else:  # CPU smoke numbers so the bench always emits a line
-        batch, image, steps, warmup = 16, 64, 3, 1
-        dtype = jnp.float32
-    log(f"[bench] platform={platform} batch={batch} image={image} dtype={dtype.__name__}")
-
-    model = ResNet50(num_classes=1000, dtype=dtype)
+    dtype = dtype or jnp.bfloat16
+    model = ResNet50(num_classes=1000, dtype=dtype, stem=stem)
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.normal(size=(batch, image, image, 3)).astype(np.float32))
     labels = jnp.asarray(rng.integers(0, 1000, size=batch), dtype=jnp.int32)
@@ -70,14 +64,50 @@ def bench_resnet50() -> tuple[float, str]:
     for _ in range(warmup):
         state, loss = step(state, batch_dict)
     jax.block_until_ready(loss)
-    log(f"[bench] warmup+compile {time.time() - t0:.1f}s, loss={float(loss):.3f}")
+    if not quiet:
+        log(f"[bench] warmup+compile {time.time() - t0:.1f}s, loss={float(loss):.3f}")
     t0 = time.time()
     for _ in range(steps):
         state, loss = step(state, batch_dict)
     jax.block_until_ready(loss)
     elapsed = time.time() - t0
     imgs_per_sec = batch * steps / elapsed
-    log(f"[bench] {steps} steps in {elapsed:.2f}s -> {imgs_per_sec:.1f} imgs/sec")
+    if not quiet:
+        log(f"[bench] {steps} steps in {elapsed:.2f}s -> {imgs_per_sec:.1f} imgs/sec")
+    return imgs_per_sec
+
+
+def bench_resnet50() -> tuple[float, str]:
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The image's sitecustomize pre-imports jax and freezes the
+        # platform default at interpreter startup — the env var alone is
+        # too late (same workaround as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        batch, image, steps, warmup = 256, 224, 20, 3
+        dtype = jnp.bfloat16
+    else:  # CPU smoke numbers so the bench always emits a line
+        batch, image, steps, warmup = 16, 64, 3, 1
+        dtype = jnp.float32
+    log(f"[bench] platform={platform} batch={batch} image={image} dtype={dtype.__name__}")
+    # space_to_depth stem: the MLPerf packing trick (see models/resnet.py)
+    # — measured +2.5% over the 7x7 stem on one chip
+    imgs_per_sec = resnet_train_throughput(
+        stem="space_to_depth",
+        batch=batch,
+        image=image,
+        steps=steps,
+        warmup=warmup,
+        dtype=dtype,
+    )
     return imgs_per_sec, platform
 
 

@@ -53,6 +53,13 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    # "conv7": the classic 7x7/s2 stem. "space_to_depth": pack 2x2 pixel
+    # blocks into channels and use a 4x4/s1 conv — mathematically a
+    # superset reparameterization of the 7x7/s2 stem (exactness of the
+    # mapping is asserted in tests), and far better MXU utilization:
+    # C=3 leaves 125/128 input lanes idle, C=12 packs 4x denser (the
+    # MLPerf TPU trick).
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -68,7 +75,27 @@ class ResNet(nn.Module):
         )
         act = nn.relu
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            b, h, w, c = x.shape
+            assert h % 2 == 0 and w % 2 == 0, "space_to_depth needs even H/W"
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            # padding (1,2): matches flax SAME for 7x7/s2 (which pads
+            # (2,3)) under the packed mapping ky = 2*ry + dy — asserted
+            # exactly in tests/test_models_ops.py
+            x = nn.Conv(
+                self.num_filters,
+                (4, 4),
+                strides=(1, 1),
+                padding=((1, 2), (1, 2)),
+                use_bias=False,
+                dtype=self.dtype,
+                name="conv_init",
+            )(x)
+        elif self.stem == "conv7":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -1,0 +1,25 @@
+"""A/B the space-to-depth stem vs the classic 7x7 stem on the chip, using
+the SAME harness as the headline bench (bench.resnet_train_throughput).
+
+Variant order matters on the tunneled device: the first in-process timed
+measurement reads absurdly high (compile/tunnel warmup skews the timer),
+so a sacrificial first variant runs before the compared positions.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import resnet_train_throughput
+
+
+def main():
+    resnet_train_throughput(stem="conv7", quiet=True)  # sacrificial
+    for stem in ("space_to_depth", "conv7", "space_to_depth"):
+        ips = resnet_train_throughput(stem=stem, quiet=True)
+        print(f"[stem] {stem}: {ips:.1f} imgs/sec", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -381,3 +381,60 @@ def test_transformer_remat_matches_plain():
         g_plain,
         g_remat,
     )
+
+
+def test_resnet_space_to_depth_stem_equivalence():
+    """The packed 4x4/s1 stem must be able to represent the 7x7/s2 stem
+    exactly: map the 7x7 weights into the packed layout and assert equal
+    conv outputs (MLPerf space-to-depth trick, models/resnet.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    B, H, W, C, O = 2, 32, 32, 3, 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, H, W, C))
+    w7 = jax.random.normal(jax.random.PRNGKey(1), (7, 7, C, O)) * 0.1
+    # the reference is the MODEL's own conv7 stem: flax SAME for 7x7/s2
+    # pads (2,3)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w7.shape, ("NHWC", "HWIO", "NHWC"))
+    ref = jax.lax.conv_general_dilated(
+        x, w7, (2, 2), [(2, 3), (2, 3)], dimension_numbers=dn
+    )
+    xp = (
+        x.reshape(B, H // 2, 2, W // 2, 2, C)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(B, H // 2, W // 2, 4 * C)
+    )
+    w2 = np.zeros((4, 4, 4 * C, O), np.float32)
+    for ry in range(4):
+        for rx in range(4):
+            for dy in range(2):
+                for dx in range(2):
+                    ky, kx = 2 * ry + dy, 2 * rx + dx  # SAME(2,3) mapping
+                    if 0 <= ky < 7 and 0 <= kx < 7:
+                        sl = slice((dy * 2 + dx) * C, (dy * 2 + dx) * C + C)
+                        w2[ry, rx, sl, :] = w7[ky, kx]
+    dn2 = jax.lax.conv_dimension_numbers(xp.shape, w2.shape, ("NHWC", "HWIO", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        xp, jnp.asarray(w2), (1, 1), [(1, 2), (1, 2)], dimension_numbers=dn2
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_space_to_depth_model_runs():
+    import jax
+    import jax.numpy as jnp
+
+    from devspace_tpu.models.resnet import ResNet
+
+    model = ResNet(
+        stage_sizes=[1, 1], num_classes=10, num_filters=8,
+        dtype=jnp.float32, stem="space_to_depth",
+    )
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert bool(jnp.isfinite(logits).all())
+    # packed stem kernel: [4, 4, 12, num_filters]
+    assert variables["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)

@@ -467,3 +467,34 @@ def test_from_torch_handles_namedtuples_and_nesting():
     out = list(from_torch(batches))
     assert isinstance(out[0], Pt) and isinstance(out[0].x, np.ndarray)
     assert isinstance(out[1]["a"]["img"], np.ndarray)
+
+
+def test_multihost_initialize_env_wiring(monkeypatch):
+    """The chart wires JAX_COORDINATOR_ADDRESS / TPU_WORKER_ID /
+    JAX_NUM_PROCESSES; multihost_initialize must translate them into the
+    jax.distributed bootstrap (and no-op off-slice)."""
+    from devspace_tpu.parallel.mesh import multihost_initialize
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert multihost_initialize() is False
+
+    calls = {}
+    monkeypatch.setattr(
+        jax.distributed,
+        "initialize",
+        lambda **kw: calls.update(kw),
+    )
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host-0:8476")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    assert multihost_initialize() is True
+    assert calls == {
+        "coordinator_address": "host-0:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    # single-process slice: no distributed init
+    calls.clear()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert multihost_initialize() is False
+    assert calls == {}
