@@ -213,11 +213,19 @@ class ChartDeployer:
         pull_secrets: Optional[list[str]] = None,
         force: bool = False,
         cache=None,
+        wait: bool = True,
+        wait_timeout: float = 40.0,
     ) -> bool:
         """Render and apply. Returns False when skipped (unchanged).
         Injects `images` (name -> full ref with built tag), `tpu.*` and
         `pullSecrets` into the render context — the reference injects the
-        same trio as helm values (deploy/helm/deploy.go:154-161)."""
+        same trio as helm values (deploy/helm/deploy.go:154-161).
+
+        ``wait``: after applying, wait up to ``wait_timeout`` (the
+        reference's 40s helm default, helm/install.go:28) for the
+        release's pods to reach Running; on timeout, print the analyze
+        report and raise — the reference runs analyze on failed helm
+        deploys (helm/install.go -> analyze import)."""
         name = self.deployment.name
         new_hash = self.chart_hash() + "|" + str(sorted((image_tags or {}).items()))
         if cache is not None and not force:
@@ -255,6 +263,8 @@ class ChartDeployer:
         for manifest in manifests:
             self.backend.apply(manifest, namespace=self.namespace)
         self._record_release(manifests)
+        if wait and wait_timeout > 0:
+            self._wait_ready(manifests, timeout=wait_timeout)
         if cache is not None:
             cache.chart_hashes[name] = new_hash
         self.log.done(
@@ -264,6 +274,70 @@ class ChartDeployer:
             self.namespace,
         )
         return True
+
+    def _wait_ready(self, manifests: list[dict], timeout: float) -> None:
+        """Wait for the release's workloads to finish rolling out —
+        observed via the controllers' own status (ready/updated replicas),
+        NOT by listing pods, so stale pods from a previous ReplicaSet or
+        Terminating pods can't fake success or failure. Analyze on timeout
+        (reference: helm/install.go wait+timeout, analyze on failed
+        release)."""
+        import time
+
+        workloads = [
+            m for m in manifests if m.get("kind") in ("Deployment", "StatefulSet")
+        ]
+        if not workloads:
+            return
+
+        def unready() -> list[str]:
+            problems = []
+            for m in workloads:
+                kind = m["kind"]
+                name = m.get("metadata", {}).get("name", "")
+                obj = self.backend.get_object(
+                    m.get("apiVersion", "apps/v1"), kind, name, self.namespace
+                )
+                if obj is None:
+                    problems.append(f"{kind}/{name}: not found")
+                    continue
+                want = (obj.get("spec") or {}).get("replicas", 1) or 1
+                st = obj.get("status") or {}
+                ready = st.get("readyReplicas") or 0
+                updated = st.get("updatedReplicas")
+                if updated is None:
+                    updated = ready
+                if ready < want or updated < want:
+                    problems.append(
+                        f"{kind}/{name}: {ready}/{want} ready, "
+                        f"{updated}/{want} updated"
+                    )
+            return problems
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not unready():
+                return
+            time.sleep(1.0)
+        remaining = unready()  # final post-deadline poll — a pod going
+        if not remaining:  # ready during the last sleep is not a failure
+            return
+        from ..analyze.analyze import create_report
+
+        self.log.error(
+            "[deploy] %s: rollout not complete within %.0fs — analyzing "
+            "(%s)",
+            self.deployment.name,
+            timeout,
+            "; ".join(remaining),
+        )
+        # through the logger so the report lands in the session log file
+        for line in create_report(self.backend, self.namespace, wait=False).splitlines():
+            self.log.error("%s", line)
+        raise ChartError(
+            f"release {self.deployment.name}: rollout not complete within "
+            f"{timeout:.0f}s ({'; '.join(remaining)})"
+        )
 
     # -- release bookkeeping ----------------------------------------------
     def _release_name(self) -> str:

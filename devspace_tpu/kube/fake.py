@@ -393,14 +393,17 @@ class FakeCluster:
         ns = meta.get("namespace") or namespace or self.default_namespace
         meta.setdefault("namespace", ns)
         name = meta.get("name", "")
+        # synthesize BEFORE storing: _synthesize_pods stamps the rollout
+        # status onto workload manifests and the stored copy must carry it
+        self._synthesize_pods(manifest, ns)
         with self._lock:
             self.objects[(kind, ns, name)] = copy.deepcopy(manifest)
-        self._synthesize_pods(manifest, ns)
         self._save_state()
         return manifest
 
     def _synthesize_pods(self, manifest: dict, ns: str) -> None:
-        """Applying a workload makes its pods 'Running' immediately."""
+        """Applying a workload makes its pods 'Running' immediately (and
+        stamps a fully-ready rollout status, like a settled controller)."""
         kind = manifest.get("kind", "")
         name = manifest.get("metadata", {}).get("name", "")
         spec = manifest.get("spec") or {}
@@ -411,6 +414,14 @@ class FakeCluster:
             replicas = spec.get("completions", spec.get("parallelism", 1)) or 1
         else:
             return
+        manifest.setdefault("status", {}).update(
+            {
+                "replicas": replicas,
+                "readyReplicas": replicas,
+                "updatedReplicas": replicas,
+                "observedGeneration": 1,
+            }
+        )
         labels = (template.get("metadata") or {}).get("labels") or {}
         containers = [
             c.get("name", "main")

@@ -350,3 +350,51 @@ def test_kaniko_builder_on_fake_cluster(tmp_path, monkeypatch):
             "registry.local/app", "t2", str(ctx), str(ctx / "Dockerfile")
         )
     assert fc.list_pods(namespace="default") == []
+
+
+def test_chart_deploy_waits_and_analyzes_on_failure(tmp_path, capsys):
+    """Failed rollouts must surface the analyze report and raise
+    (reference: helm/install.go 40s wait + analyze on failed release)."""
+    from devspace_tpu.deploy.chart import ChartDeployer, ChartError
+
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    chart = tmp_path / "chart"
+    write_file(str(chart / "chart.yaml"), "name: app\nversion: 0.1.0\n")
+    write_file(
+        str(chart / "templates" / "deploy.yaml"),
+        "apiVersion: apps/v1\n"
+        "kind: Deployment\n"
+        "metadata:\n  name: ${{ release.name }}\n"
+        "spec:\n  replicas: 1\n  template:\n    metadata:\n"
+        "      labels:\n        app: ${{ release.name }}\n"
+        "    spec:\n"
+        "      containers:\n        - name: main\n          image: x\n",
+    )
+    from devspace_tpu.utils import log as logutil
+
+    dep = latest.DeploymentConfig(
+        name="app", chart=latest.ChartConfig(path=str(chart))
+    )
+    deployer = ChartDeployer(
+        fc, dep, "default", logger=logutil.StdoutLogger()
+    )
+    # healthy: fake backend synthesizes Running pods -> returns promptly
+    assert deployer.deploy(wait_timeout=5.0) is True
+
+    # wedge the rollout: controller reports 0 ready -> analyze + raise.
+    # (status-based, so stale-but-Running pods from an old ReplicaSet
+    # can't fake success)
+    obj = fc.objects[("Deployment", "default", "app")]
+    obj["status"]["readyReplicas"] = 0
+    for (ns, name) in list(fc.pods):
+        fc.set_pod_phase(name, "Pending", namespace=ns)
+    manifests = [
+        {"kind": "Deployment", "apiVersion": "apps/v1", "metadata": {"name": "app"}}
+    ]
+    with pytest.raises(ChartError, match="rollout not complete"):
+        deployer._wait_ready(manifests, timeout=2.0)
+    out = capsys.readouterr().out
+    assert "Analysis of namespace" in out
+    assert "Pending" in out
+    # wait_timeout=0 means don't block (and don't fail)
+    assert deployer.deploy(force=True, wait_timeout=0) is True
