@@ -16,9 +16,10 @@ Design, TPU-first:
   generation does not block a short one — per-slot positions make every
   slot's causal mask independent.
 - **Bucketed prefill**: prompts are padded to power-of-two buckets and
-  prefit via a scanned decode on a single-slot cache, then scattered into
-  the engine cache — a handful of compilations total, amortized across
-  the process lifetime.
+  prefit in ONE full-sequence forward pass (``forward(return_kv=True)``
+  — big MXU matmuls, not a token-by-token scan), then the K/V is
+  scattered into the engine cache — a handful of compilations total,
+  amortized across the process lifetime.
 - **Device-side sampling + chunked decode**: sampling (greedy or
   per-slot temperature) happens inside the jitted step, and up to
   ``chunk_max`` tokens are decoded per dispatch via ``lax.scan`` — one
@@ -193,16 +194,15 @@ class InferenceEngine:
         }
 
         def prefill(params, prompt):  # prompt [1, T_bucket]
-            # Cache sized to the bucket, not max_len: prefill attention is
-            # O(bucket^2) and jit is shape-keyed per bucket anyway.
-            cache = tfm.init_kv_cache(self.cfg, 1, prompt.shape[1])
-
-            def step(cache, tok):
-                logits, cache = tfm.decode_step(params, cache, tok[:, None], self.cfg)
-                return cache, logits
-
-            cache, logits = jax.lax.scan(step, cache, jnp.moveaxis(prompt, 1, 0))
-            return cache, logits  # logits [T_bucket, 1, vocab]
+            # ONE full-sequence forward (big MXU matmuls) instead of a
+            # token-by-token decode scan — forward's return_kv hands back
+            # the roped per-layer K/V in exactly the cache layout. Cast to
+            # the cache dtype: params may be f32 while the cache is bf16.
+            logits, (k, v) = tfm.forward(params, prompt, self.cfg, return_kv=True)
+            return {
+                "k": k.astype(self.cfg.dtype),
+                "v": v.astype(self.cfg.dtype),
+            }, logits  # k/v [L, 1, T_bucket, Hkv, D]
 
         # jit's own shape-keyed cache compiles once per prompt bucket
         self._prefill = jax.jit(prefill)
@@ -321,7 +321,7 @@ class InferenceEngine:
         key, sub = jax.random.split(key)
         self._keys = self._keys.at[slot_idx].set(key)
         # first generated token comes from the last REAL prompt position
-        first = self._sample(req, sub, logits[t - 1, 0])
+        first = self._sample(req, sub, logits[0, t - 1])
         self._emit(slot_idx, int(first))
 
     def _sample(self, req: Request, key, logits: jax.Array):

@@ -178,7 +178,8 @@ def forward(
     attention_fn: Optional[Callable] = None,
     positions: Optional[jax.Array] = None,
     remat: bool = False,
-) -> jax.Array:
+    return_kv: bool = False,
+):
     """Training/prefill forward -> logits [B, T, vocab] (float32).
 
     ``attention_fn(q, k, v) -> ctx`` defaults to full causal attention;
@@ -190,7 +191,16 @@ def forward(
     activation memory from O(layers x T x D) to O(T x D) at ~1/3 extra
     FLOPs — the standard trade for long-context training (pair with
     ring attention; use ``partial(forward, remat=True)`` as the trainer's
-    forward)."""
+    forward).
+
+    ``return_kv=True`` additionally returns the per-layer roped K/V
+    stacks ([L, B, T, Hkv, D] each) — exactly the KV-cache layout
+    ``decode_tokens`` consumes, so serving prefill is ONE full-sequence
+    forward (big MXU matmuls) instead of a token-by-token decode scan.
+    Incompatible with ``remat`` (checkpointed layers would recompute the
+    K/V we want to keep)."""
+    if return_kv and remat:
+        raise ValueError("return_kv does not compose with remat")
     attn = attention_fn or partial(default_attention, causal=True)
     b, t = tokens.shape
     hd = cfg.head_dim
@@ -200,6 +210,8 @@ def forward(
     cos, sin = rope_frequencies(cfg, positions)
     h = params["embed"][tokens]  # [B, T, D]
 
+    kv_out: list[tuple[jax.Array, jax.Array]] = []
+
     def layer_fn(h, layer, cos, sin):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
@@ -207,6 +219,8 @@ def forward(
         v = (x @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        if return_kv:
+            kv_out.append((k, v))
         ctx = attn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
         h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
@@ -218,7 +232,12 @@ def forward(
     for layer in params["layers"]:
         h = layer_fn(h, layer, cos, sin)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return (h @ params["lm_head"]).astype(jnp.float32)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    if return_kv:
+        k_stack = jnp.stack([k for k, _ in kv_out])  # [L, B, T, Hkv, D]
+        v_stack = jnp.stack([v for _, v in kv_out])
+        return logits, (k_stack, v_stack)
+    return logits
 
 
 # -- KV-cache decode --------------------------------------------------------

@@ -438,3 +438,30 @@ def test_resnet_space_to_depth_model_runs():
     assert bool(jnp.isfinite(logits).all())
     # packed stem kernel: [4, 4, 12, num_filters]
     assert variables["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)
+
+
+def test_forward_return_kv_matches_decode_cache():
+    """forward(return_kv=True) must hand back exactly the K/V the decode
+    scan would have written (same rope, same layout) — the serving
+    prefill relies on it."""
+    from devspace_tpu.models import transformer as tfm
+
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab_size)
+
+    logits, (k, v) = tfm.forward(params, tokens, cfg, return_kv=True)
+    assert k.shape == (cfg.n_layers, 2, 9, cfg.n_kv_heads, cfg.head_dim)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(tfm.forward(params, tokens, cfg)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    cache = tfm.init_kv_cache(cfg, 2, 9)
+    for i in range(9):
+        _, cache = tfm.decode_step(params, cache, tokens[:, i : i + 1], cfg)
+    np.testing.assert_allclose(np.asarray(cache["k"]), np.asarray(k), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(cache["v"]), np.asarray(v), rtol=2e-3, atol=2e-3)
+
+    with pytest.raises(ValueError, match="remat"):
+        tfm.forward(params, tokens, cfg, return_kv=True, remat=True)
