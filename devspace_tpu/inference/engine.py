@@ -30,7 +30,8 @@ Design, TPU-first:
   (truncated host-side; the cache-write-ahead is safe — every position
   is rewritten in the same step that first attends to it).
 
-Greedy and per-request-temperature sampling; optional EOS early stop.
+Per-request sampling: greedy, temperature, top-k and top-p (nucleus);
+optional EOS early stop.
 """
 
 from __future__ import annotations
@@ -46,6 +47,39 @@ import jax.numpy as jnp
 from ..models import transformer as tfm
 
 
+def sample_logits(key, logits, temperature, top_k=0, top_p=1.0):
+    """One-token sampling with greedy / temperature / top-k / top-p —
+    pure jnp so it runs inside the jitted decode chunk (vmapped per slot)
+    and host-side for the prefill's first token.
+
+    ``temperature <= 0`` is greedy (k/p ignored). ``top_k == 0`` and
+    ``top_p >= 1`` disable their filters. Dynamic per-slot k/p: filters
+    are computed by sorting rather than lax.top_k so k need not be a
+    static constant."""
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sorted_desc = jnp.sort(scaled)[::-1]
+    # top-k: keep logits >= the k-th largest (k=0 -> keep all)
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, vocab - 1)]
+    keep_k = jnp.where(top_k > 0, scaled >= kth, True)
+    # top-p: keep tokens whose mass-before-them (sorted desc) is < top_p —
+    # the shifted-cumsum form always keeps >= 1 token and is immune to
+    # float32 cumsum never quite reaching top_p on a large vocab
+    probs_desc = jax.nn.softmax(sorted_desc)
+    shifted = jnp.cumsum(probs_desc) - probs_desc
+    count = jnp.sum(shifted < top_p)
+    p_threshold = sorted_desc[jnp.clip(count - 1, 0, vocab - 1)]
+    keep_p = jnp.where(top_p < 1.0, scaled >= p_threshold, True)
+    filtered = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 @dataclass
 class Request:
     prompt_ids: list[int]
@@ -53,6 +87,8 @@ class Request:
     temperature: float = 0.0
     eos_id: Optional[int] = None
     seed: int = 0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # >= 1 = disabled
     # filled by the engine
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -164,19 +200,37 @@ class InferenceEngine:
         self.chunk_max = max(1, int(chunk_max))
         self._keys = jnp.zeros((max_slots, 2), jnp.uint32)
 
-        def decode_chunk(params, cache, tokens, positions, temps, keys, n_steps):
+        def decode_chunk(
+            params,
+            cache,
+            tokens,
+            positions,
+            temps,
+            top_ks,
+            top_ps,
+            keys,
+            n_steps,
+            use_filters,
+        ):
             def step(carry, _):
                 cache, tok, pos, keys = carry
                 logits, cache = tfm.decode_tokens(params, cache, tok, pos, cfg)
                 split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
                 keys, subs = split[:, 0], split[:, 1]
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                sampled = jax.vmap(
-                    lambda k, l, t: jax.random.categorical(
-                        k, l / jnp.maximum(t, 1e-6)
+                if use_filters:
+                    tok = jax.vmap(sample_logits)(
+                        subs, logits, temps, top_ks, top_ps
                     )
-                )(subs, logits, temps).astype(jnp.int32)
-                tok = jnp.where(temps > 0, sampled, greedy)
+                else:
+                    # cheap path: no per-token vocab sort when no active
+                    # slot asked for top-k/top-p
+                    sampled = jax.vmap(
+                        lambda k, l, t: jax.random.categorical(
+                            k, l / jnp.maximum(t, 1e-6)
+                        )
+                    )(subs, logits, temps).astype(jnp.int32)
+                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    tok = jnp.where(temps > 0, sampled, greedy)
                 return (cache, tok, pos + 1, keys), tok
 
             (cache, _, _, keys), toks = jax.lax.scan(
@@ -184,13 +238,16 @@ class InferenceEngine:
             )
             return cache, keys, toks  # toks [n_steps, B]
 
-        # one compile per chunk size; chunk sizes are clamped to powers of
-        # two <= chunk_max so the set stays tiny
+        # one compile per (chunk size, filters on/off) — both static
         from functools import partial as _partial
 
         self._decode_chunk = {
-            k: jax.jit(_partial(decode_chunk, n_steps=k), donate_argnums=1)
+            (k, filt): jax.jit(
+                _partial(decode_chunk, n_steps=k, use_filters=filt),
+                donate_argnums=1,
+            )
             for k in self._chunk_sizes()
+            for filt in (False, True)
         }
 
         def prefill(params, prompt):  # prompt [1, T_bucket]
@@ -233,6 +290,8 @@ class InferenceEngine:
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -243,7 +302,17 @@ class InferenceEngine:
                 f"prompt+generation ({len(prompt_ids)}+{max_new_tokens}) "
                 f"exceeds max_len {self.max_len}"
             )
-        req = Request(list(prompt_ids), int(max_new_tokens), temperature, eos_id, seed)
+        if top_k < 0 or top_p <= 0.0:
+            raise ValueError("need top_k >= 0 and top_p > 0 (>= 1 disables)")
+        req = Request(
+            list(prompt_ids),
+            int(max_new_tokens),
+            temperature,
+            eos_id,
+            seed,
+            top_k=int(top_k),
+            top_p=float(top_p),
+        )
         with self._submit_lock:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
@@ -295,7 +364,7 @@ class InferenceEngine:
     def _pick_chunk(self, n: int) -> int:
         """Largest compiled chunk size <= n."""
         best = 1
-        for k in self._decode_chunk:
+        for k in self._chunk_sizes():
             if best < k <= n:
                 best = k
         return best
@@ -321,13 +390,10 @@ class InferenceEngine:
         key, sub = jax.random.split(key)
         self._keys = self._keys.at[slot_idx].set(key)
         # first generated token comes from the last REAL prompt position
-        first = self._sample(req, sub, logits[0, t - 1])
+        first = sample_logits(
+            sub, logits[0, t - 1], req.temperature, req.top_k, req.top_p
+        )
         self._emit(slot_idx, int(first))
-
-    def _sample(self, req: Request, key, logits: jax.Array):
-        if req.temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(key, logits / req.temperature)
 
     def _emit(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
@@ -394,6 +460,20 @@ class InferenceEngine:
                 ],
                 dtype=jnp.float32,
             )
+            top_ks = jnp.asarray(
+                [
+                    (s.req.top_k if s.req is not None else 0)
+                    for s in self.slots
+                ],
+                dtype=jnp.int32,
+            )
+            top_ps = jnp.asarray(
+                [
+                    (s.req.top_p if s.req is not None else 1.0)
+                    for s in self.slots
+                ],
+                dtype=jnp.float32,
+            )
             # Chunk size: sized to the LONGEST remaining want (rounded
             # down to a compiled power of two) — clamping to the shortest
             # would put the whole batch back in the one-round-trip-per-
@@ -411,9 +491,22 @@ class InferenceEngine:
             # NOTE positions hold the index of the last emitted token: its
             # K/V has not been written yet (prefill wrote only the prompt),
             # so the decode step both writes it and attends through it.
+            filters_on = any(
+                s.req is not None and (s.req.top_k > 0 or s.req.top_p < 1.0)
+                for s in self.slots
+            )
             try:
-                self.cache, self._keys, toks = self._decode_chunk[k_steps](
-                    self.params, self.cache, tokens, positions, temps, self._keys
+                self.cache, self._keys, toks = self._decode_chunk[
+                    (k_steps, filters_on)
+                ](
+                    self.params,
+                    self.cache,
+                    tokens,
+                    positions,
+                    temps,
+                    top_ks,
+                    top_ps,
+                    self._keys,
                 )
                 toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
                 for i in active:

@@ -3,7 +3,7 @@ TPU pod, reached through `devspace-tpu dev`'s port-forward and health-checked
 by `devspace-tpu analyze`.
 
 Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
-optional "temperature", "eos_id"}) and /healthz. Concurrent requests are
+optional "temperature", "eos_id", "top_k", "top_p"}) and /healthz. Concurrent requests are
 continuously batched by devspace_tpu.inference.InferenceEngine
 (iteration-level scheduling — a long generation never blocks a short one).
 Defaults to the TINY config so it runs anywhere; set MODEL=llama2-7b on a
@@ -37,9 +37,22 @@ class Server:
             chunk_max=int(os.environ.get("CHUNK_MAX", 8)),
         ).start()
 
-    def generate(self, prompt_ids, max_new_tokens, temperature=0.0, eos_id=None):
+    def generate(
+        self,
+        prompt_ids,
+        max_new_tokens,
+        temperature=0.0,
+        eos_id=None,
+        top_k=0,
+        top_p=1.0,
+    ):
         req = self.engine.submit(
-            prompt_ids, max_new_tokens, temperature=temperature, eos_id=eos_id
+            prompt_ids,
+            max_new_tokens,
+            temperature=temperature,
+            eos_id=eos_id,
+            top_k=top_k,
+            top_p=top_p,
         )
         return req.result(timeout=600)
 
@@ -80,6 +93,8 @@ def main():
                     eos_id=(
                         int(req["eos_id"]) if req.get("eos_id") is not None else None
                     ),
+                    top_k=int(req.get("top_k", 0)),
+                    top_p=float(req.get("top_p", 1.0)),
                 )
                 self._json(200, {"tokens": tokens})
             except Exception as e:  # noqa: BLE001
