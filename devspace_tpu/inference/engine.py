@@ -101,6 +101,34 @@ class Request:
             raise RuntimeError(self.error)
         return self.tokens
 
+    def stream(self, timeout: Optional[float] = None, poll: float = 0.02):
+        """Yield tokens as they are generated (list appends by the engine
+        thread are atomic under the GIL; chunked decode delivers them in
+        bursts of up to chunk_max). Raises like ``result`` on error, and
+        TimeoutError when no NEW token arrives within ``timeout`` (the
+        deadline resets on progress — a long healthy generation never
+        times out)."""
+        import time as _time
+
+        sent = 0
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            n = len(self.tokens)
+            if n > sent and timeout is not None:
+                deadline = _time.monotonic() + timeout
+            while sent < n:
+                yield self.tokens[sent]
+                sent += 1
+            if self.done.is_set():
+                if self.error:
+                    raise RuntimeError(self.error)
+                for tok in self.tokens[sent:]:
+                    yield tok
+                return
+            if deadline is not None and _time.monotonic() > deadline:
+                raise TimeoutError("generation stalled")
+            self.done.wait(poll)
+
 
 class _Slot:
     __slots__ = ("req", "length", "remaining", "last_token")

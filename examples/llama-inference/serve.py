@@ -86,9 +86,7 @@ def main():
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length))
-                tokens = server.generate(
-                    req["prompt_ids"],
-                    int(req.get("max_new_tokens", 16)),
+                kwargs = dict(
                     temperature=float(req.get("temperature", 0.0)),
                     eos_id=(
                         int(req["eos_id"]) if req.get("eos_id") is not None else None
@@ -96,6 +94,36 @@ def main():
                     top_k=int(req.get("top_k", 0)),
                     top_p=float(req.get("top_p", 1.0)),
                 )
+                prompt = req["prompt_ids"]
+                n = int(req.get("max_new_tokens", 16))
+                if req.get("stream"):
+                    # newline-delimited JSON: one {"token": t} per token,
+                    # then {"done": true}; tokens flush as the engine's
+                    # chunked decode emits them. Once the 200 headers are
+                    # out, errors must be delivered IN-stream — a second
+                    # HTTP response would corrupt the body.
+                    handle = server.engine.submit(prompt, n, **kwargs)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.end_headers()
+                    try:
+                        for tok in handle.stream(timeout=600):
+                            self.wfile.write(
+                                json.dumps({"token": tok}).encode() + b"\n"
+                            )
+                            self.wfile.flush()
+                        self.wfile.write(json.dumps({"done": True}).encode() + b"\n")
+                    except ConnectionError:
+                        pass  # client went away; the engine finishes the slot
+                    except Exception as e:  # noqa: BLE001 — engine error/stall
+                        try:
+                            self.wfile.write(
+                                json.dumps({"error": str(e)}).encode() + b"\n"
+                            )
+                        except ConnectionError:
+                            pass
+                    return
+                tokens = server.generate(prompt, n, **kwargs)
                 self._json(200, {"tokens": tokens})
             except Exception as e:  # noqa: BLE001
                 self._json(400, {"error": str(e)})

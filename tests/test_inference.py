@@ -236,3 +236,24 @@ def test_sample_logits_top_p_boundary():
         for s in range(30)
     }
     assert len(draws) > 5  # not collapsed to argmax
+
+
+def test_request_stream_yields_incrementally(params):
+    """Request.stream() must yield every token exactly once, in order,
+    and raise on engine failure instead of hanging."""
+    engine = InferenceEngine(params, CFG, max_slots=1, max_len=48).start()
+    try:
+        h = engine.submit([2, 7, 1], 9)
+        streamed = list(h.stream(timeout=120))
+        assert streamed == h.result(timeout=1)
+        assert len(streamed) == 9
+    finally:
+        engine.stop()
+    # stream on a failed request raises
+    from devspace_tpu.inference.engine import Request
+
+    failed = Request([1], 2)
+    failed.error = "boom"
+    failed.done.set()
+    with pytest.raises(RuntimeError, match="boom"):
+        list(failed.stream(timeout=1))
