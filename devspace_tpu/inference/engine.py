@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -108,14 +109,12 @@ class Request:
         TimeoutError when no NEW token arrives within ``timeout`` (the
         deadline resets on progress — a long healthy generation never
         times out)."""
-        import time as _time
-
         sent = 0
-        deadline = None if timeout is None else _time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             n = len(self.tokens)
             if n > sent and timeout is not None:
-                deadline = _time.monotonic() + timeout
+                deadline = time.monotonic() + timeout
             while sent < n:
                 yield self.tokens[sent]
                 sent += 1
@@ -125,7 +124,7 @@ class Request:
                 for tok in self.tokens[sent:]:
                     yield tok
                 return
-            if deadline is not None and _time.monotonic() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("generation stalled")
             self.done.wait(poll)
 
@@ -213,6 +212,12 @@ class InferenceEngine:
         self.cache = self._fresh_cache()
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
+        # serving counters (read via stats(); mutated by the scheduler
+        # thread and — for fail-outs — by stop(); read-atomic under the GIL)
+        self._started_at = None  # set by start()
+        self.requests_completed = 0
+        self.requests_failed = 0
+        self.tokens_generated = 0
         self._stop = threading.Event()
         # serializes submit's check+put against stop's set+drain, closing
         # the window where a request lands in the queue after the drain
@@ -348,9 +353,29 @@ class InferenceEngine:
         return req
 
     def start(self) -> "InferenceEngine":
+        self._started_at = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
+
+    def stats(self) -> dict:
+        """Serving counters: completed/failed requests, tokens generated,
+        active slots, queue depth, uptime and mean tokens/sec."""
+        uptime = (
+            time.monotonic() - self._started_at if self._started_at else 0.0
+        )
+        return {
+            "requests_completed": self.requests_completed,
+            "requests_failed": self.requests_failed,
+            "tokens_generated": self.tokens_generated,
+            "active_slots": sum(1 for s in self.slots if s.req is not None),
+            "max_slots": self.max_slots,
+            "queued": self.pending.qsize(),
+            "uptime_s": round(uptime, 1),
+            "tokens_per_sec": round(self.tokens_generated / uptime, 2)
+            if uptime > 0
+            else 0.0,
+        }
 
     def stop(self) -> None:
         """Stop the scheduler and fail out any unfinished requests so no
@@ -365,10 +390,15 @@ class InferenceEngine:
     # -- scheduler ---------------------------------------------------------
     def _fail_outstanding(self, reason: str) -> None:
         for slot in self.slots:
-            if slot.req is not None:
-                slot.req.error = reason
-                slot.req.done.set()
-                slot.req = None
+            req = slot.req  # snapshot: a live scheduler may race us when
+            if req is None:  # stop()'s join timed out on a wedged dispatch
+                continue
+            slot.req = None
+            if req.done.is_set():
+                continue  # completed concurrently — don't double-count
+            req.error = reason
+            req.done.set()
+            self.requests_failed += 1
         while True:
             try:
                 req = self.pending.get_nowait()
@@ -376,6 +406,7 @@ class InferenceEngine:
                 break
             req.error = reason
             req.done.set()
+            self.requests_failed += 1
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -427,6 +458,7 @@ class InferenceEngine:
         slot = self.slots[slot_idx]
         req = slot.req
         req.tokens.append(token)
+        self.tokens_generated += 1
         slot.last_token = token
         slot.length += 1
         slot.remaining -= 1
@@ -435,6 +467,7 @@ class InferenceEngine:
         ):
             req.done.set()
             slot.req = None
+            self.requests_completed += 1
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -452,6 +485,7 @@ class InferenceEngine:
                     req.error = str(e)
                     req.done.set()
                     self.slots[i].req = None
+                    self.requests_failed += 1
             active = [i for i, s in enumerate(self.slots) if s.req is not None]
             if not active:
                 # idle: block for the next request and admit it directly
@@ -466,6 +500,7 @@ class InferenceEngine:
                     req.error = str(e)
                     req.done.set()
                     self.slots[0].req = None
+                    self.requests_failed += 1
                 continue
             tokens = jnp.asarray(
                 [

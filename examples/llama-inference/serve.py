@@ -75,7 +75,14 @@ def main():
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True, "model": os.environ.get("MODEL", "tiny")})
+                self._json(
+                    200,
+                    {
+                        "ok": True,
+                        "model": os.environ.get("MODEL", "tiny"),
+                        **server.engine.stats(),
+                    },
+                )
             else:
                 self._json(404, {"error": "not found"})
 

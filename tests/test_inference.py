@@ -257,3 +257,20 @@ def test_request_stream_yields_incrementally(params):
     failed.done.set()
     with pytest.raises(RuntimeError, match="boom"):
         list(failed.stream(timeout=1))
+
+
+def test_engine_stats_counters(params):
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=48).start()
+    try:
+        engine.submit([1, 2], 4).result(timeout=120)
+        engine.submit([3], 3).result(timeout=120)
+        with pytest.raises(ValueError):
+            engine.submit([], 1)  # rejected before counters
+        stats = engine.stats()
+    finally:
+        engine.stop()
+    assert stats["requests_completed"] == 2
+    assert stats["requests_failed"] == 0
+    assert stats["tokens_generated"] == 7
+    assert stats["active_slots"] == 0 and stats["queued"] == 0
+    assert stats["uptime_s"] > 0 and stats["tokens_per_sec"] > 0
