@@ -301,16 +301,40 @@ class ChartDeployer:
                 if obj is None:
                     problems.append(f"{kind}/{name}: not found")
                     continue
-                want = (obj.get("spec") or {}).get("replicas", 1) or 1
+                want = (obj.get("spec") or {}).get("replicas")
+                if want is None:  # only an *absent* replicas defaults to 1;
+                    want = 1  # an explicit 0 is a deliberate scale-to-zero
                 st = obj.get("status") or {}
+                # kubectl-rollout-status logic: until the controller has
+                # observed this generation, its status fields describe the
+                # PREVIOUS revision — a re-deploy would otherwise read the
+                # old revision's full readiness as instant success.
+                gen = (obj.get("metadata") or {}).get("generation")
+                observed = st.get("observedGeneration")
+                if gen is not None and (observed is None or observed < gen):
+                    problems.append(
+                        f"{kind}/{name}: generation {gen} not yet observed"
+                    )
+                    continue
                 ready = st.get("readyReplicas") or 0
                 updated = st.get("updatedReplicas")
                 if updated is None:
                     updated = ready
+                total = st.get("replicas")
+                if total is None:
+                    total = ready
                 if ready < want or updated < want:
                     problems.append(
                         f"{kind}/{name}: {ready}/{want} ready, "
                         f"{updated}/{want} updated"
+                    )
+                elif total > want:
+                    # scale-down not finished: old-revision pods still
+                    # counted (kubectl waits for status.replicas to drop
+                    # to spec.replicas, e.g. 3 -> 0 scale-to-zero)
+                    problems.append(
+                        f"{kind}/{name}: {total} replicas still running, "
+                        f"want {want}"
                     )
             return problems
 

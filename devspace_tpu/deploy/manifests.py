@@ -165,7 +165,18 @@ def deploy_all(
         from .chart import ChartDeployer
 
         if isinstance(deployer, ChartDeployer):
-            kwargs.update(tpu=config.tpu, pull_secrets=pull_secrets)
+            # Honor the config's rollout-wait knobs (reference honors
+            # Helm.Wait/Helm.Timeout, deploy/helm/deploy.go:163-168);
+            # defaults match helm's wait=true / 40s (helm/install.go:28).
+            chart_cfg = d.chart
+            kwargs.update(
+                tpu=config.tpu,
+                pull_secrets=pull_secrets,
+                wait=True if chart_cfg.wait is None else bool(chart_cfg.wait),
+                wait_timeout=float(
+                    40 if chart_cfg.timeout is None else chart_cfg.timeout
+                ),
+            )
         if deployer.deploy(**kwargs):
             count += 1
     return count

@@ -388,7 +388,11 @@ class InferenceEngine:
             self._fail_outstanding("engine stopped")
 
     # -- scheduler ---------------------------------------------------------
-    def _fail_outstanding(self, reason: str) -> None:
+    def _fail_outstanding(self, reason: str, drain_queue: bool = True) -> None:
+        """Fail slot-resident requests (their K/V lives in the cache).
+        ``drain_queue=False`` spares queued requests that were never
+        admitted — after a cache loss they have no state to lose and a
+        rebuilt cache can still serve them; only stop() drains the queue."""
         for slot in self.slots:
             req = slot.req  # snapshot: a live scheduler may race us when
             if req is None:  # stop()'s join timed out on a wedged dispatch
@@ -399,6 +403,8 @@ class InferenceEngine:
             req.error = reason
             req.done.set()
             self.requests_failed += 1
+        if not drain_queue:
+            return
         while True:
             try:
                 req = self.pending.get_nowait()
@@ -407,6 +413,25 @@ class InferenceEngine:
             req.error = reason
             req.done.set()
             self.requests_failed += 1
+
+    def _recover_cache_if_lost(self) -> None:
+        """After a failed _admit: self.cache may have been donated into
+        _insert without the reassignment happening. If the prefill raised
+        (the common failure) the cache was never donated and co-resident
+        requests are untouched; only when _insert itself died after
+        donation is the buffer gone — then in-flight requests' K/V is
+        unrecoverable, so fail them and rebuild, exactly like the decode
+        failure path."""
+        lost = False
+        try:
+            lost = any(a.is_deleted() for a in self.cache.values())
+        except AttributeError:  # non-jax.Array leaves (tests with numpy)
+            lost = False
+        if lost:
+            self._fail_outstanding(
+                "kv cache lost in failed admission", drain_queue=False
+            )
+            self.cache = self._fresh_cache()
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -486,6 +511,7 @@ class InferenceEngine:
                     req.done.set()
                     self.slots[i].req = None
                     self.requests_failed += 1
+                    self._recover_cache_if_lost()
             active = [i for i, s in enumerate(self.slots) if s.req is not None]
             if not active:
                 # idle: block for the next request and admit it directly
@@ -501,6 +527,7 @@ class InferenceEngine:
                     req.done.set()
                     self.slots[0].req = None
                     self.requests_failed += 1
+                    self._recover_cache_if_lost()
                 continue
             tokens = jnp.asarray(
                 [
@@ -582,5 +609,5 @@ class InferenceEngine:
                 # invalid; fail everything in flight rather than hang
                 # every caller, then rebuild a clean cache and keep
                 # serving new requests.
-                self._fail_outstanding(f"decode failed: {e}")
+                self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
                 self.cache = self._fresh_cache()  # donated buffer is gone

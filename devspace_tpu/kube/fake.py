@@ -409,17 +409,29 @@ class FakeCluster:
         spec = manifest.get("spec") or {}
         template = spec.get("template") or {}
         if kind in ("Deployment", "StatefulSet", "ReplicaSet"):
-            replicas = spec.get("replicas", 1) or 1
+            replicas = spec.get("replicas")
+            if replicas is None:  # explicit 0 means scale-to-zero: no pods
+                replicas = 1
         elif kind == "Job":
             replicas = spec.get("completions", spec.get("parallelism", 1)) or 1
         else:
             return
+        # API-server semantics: each apply bumps metadata.generation; the
+        # (settled) fake controller immediately observes it — real clusters
+        # lag here, which is what ChartDeployer._wait_ready guards against.
+        with self._lock:
+            prev = self.objects.get(
+                (kind, manifest["metadata"].get("namespace", ns), name)
+            )
+        prev_gen = ((prev or {}).get("metadata") or {}).get("generation", 0)
+        generation = prev_gen + 1
+        manifest["metadata"]["generation"] = generation
         manifest.setdefault("status", {}).update(
             {
                 "replicas": replicas,
                 "readyReplicas": replicas,
                 "updatedReplicas": replicas,
-                "observedGeneration": 1,
+                "observedGeneration": generation,
             }
         )
         labels = (template.get("metadata") or {}).get("labels") or {}

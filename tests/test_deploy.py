@@ -398,3 +398,104 @@ def test_chart_deploy_waits_and_analyzes_on_failure(tmp_path, capsys):
     assert "Pending" in out
     # wait_timeout=0 means don't block (and don't fail)
     assert deployer.deploy(force=True, wait_timeout=0) is True
+
+
+def _simple_chart(tmp_path, replicas=1):
+    chart = tmp_path / "chart"
+    write_file(str(chart / "chart.yaml"), "name: app\nversion: 0.1.0\n")
+    write_file(
+        str(chart / "templates" / "deploy.yaml"),
+        "apiVersion: apps/v1\n"
+        "kind: Deployment\n"
+        "metadata:\n  name: ${{ release.name }}\n"
+        f"spec:\n  replicas: {replicas}\n  template:\n    metadata:\n"
+        "      labels:\n        app: ${{ release.name }}\n"
+        "    spec:\n"
+        "      containers:\n        - name: main\n          image: x\n",
+    )
+    return str(chart)
+
+
+def test_wait_ready_requires_observed_generation(tmp_path):
+    """A re-deploy must not trust status fields from the previous revision:
+    until observedGeneration catches up with metadata.generation the
+    controller's ready counts describe the OLD revision (kubectl
+    rollout-status logic)."""
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    dep = latest.DeploymentConfig(
+        name="app", chart=latest.ChartConfig(path=_simple_chart(tmp_path))
+    )
+    deployer = ChartDeployer(fc, dep, "default")
+    assert deployer.deploy(wait_timeout=5.0) is True
+    # simulate a real (laggy) controller: spec changed -> generation bumped,
+    # but status still carries the previous revision's observation
+    obj = fc.objects[("Deployment", "default", "app")]
+    obj["metadata"]["generation"] = 5
+    obj["status"]["observedGeneration"] = 4  # stale, yet fully "ready"
+    manifests = [
+        {"kind": "Deployment", "apiVersion": "apps/v1", "metadata": {"name": "app"}}
+    ]
+    with pytest.raises(ChartError, match="not yet observed"):
+        deployer._wait_ready(manifests, timeout=1.5)
+    # controller catches up -> wait succeeds on the same status counts
+    obj["status"]["observedGeneration"] = 5
+    deployer._wait_ready(manifests, timeout=1.5)
+
+
+def test_wait_ready_scale_to_zero_is_ready(tmp_path):
+    """replicas: 0 is a deliberate scale-to-zero — 0/0 ready is success,
+    not a 40s timeout."""
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    dep = latest.DeploymentConfig(
+        name="app", chart=latest.ChartConfig(path=_simple_chart(tmp_path, replicas=0))
+    )
+    deployer = ChartDeployer(fc, dep, "default")
+    assert deployer.deploy(wait_timeout=3.0) is True  # must not raise
+    # and scale-to-zero synthesized no pods
+    assert fc.list_pods(label_selector={"app": "app"}) == []
+    # mid-scale-down (real controller: generation observed, old pods not
+    # yet gone -> status.replicas still 3): NOT complete yet
+    obj = fc.objects[("Deployment", "default", "app")]
+    obj["status"]["replicas"] = 3
+    manifests = [
+        {"kind": "Deployment", "apiVersion": "apps/v1", "metadata": {"name": "app"}}
+    ]
+    with pytest.raises(ChartError, match="still running"):
+        deployer._wait_ready(manifests, timeout=1.5)
+    obj["status"]["replicas"] = 0  # old pods terminated -> done
+    deployer._wait_ready(manifests, timeout=1.5)
+
+
+def test_deploy_all_plumbs_wait_and_timeout(tmp_path, monkeypatch):
+    """ChartConfig.wait/timeout must reach ChartDeployer.deploy (the
+    reference honors Helm.Wait/Helm.Timeout, deploy/helm/deploy.go:163-168)
+    instead of the engine hardcoding wait=True/40s."""
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    seen = {}
+
+    def fake_deploy(self, **kwargs):
+        seen.update(kwargs)
+        return True
+
+    monkeypatch.setattr(ChartDeployer, "deploy", fake_deploy)
+    cfg = latest.Config(
+        version=latest.VERSION,
+        deployments=[
+            latest.DeploymentConfig(
+                name="app",
+                chart=latest.ChartConfig(
+                    path=_simple_chart(tmp_path), wait=False, timeout=120
+                ),
+            )
+        ],
+    )
+    deploy_all(fc, cfg, "default")
+    assert seen["wait"] is False
+    assert seen["wait_timeout"] == 120.0
+    # defaults: wait=True, helm's 40s
+    seen.clear()
+    cfg.deployments[0].chart.wait = None
+    cfg.deployments[0].chart.timeout = None
+    deploy_all(fc, cfg, "default")
+    assert seen["wait"] is True
+    assert seen["wait_timeout"] == 40.0

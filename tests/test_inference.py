@@ -274,3 +274,72 @@ def test_engine_stats_counters(params):
     assert stats["tokens_generated"] == 7
     assert stats["active_slots"] == 0 and stats["queued"] == 0
     assert stats["uptime_s"] > 0 and stats["tokens_per_sec"] > 0
+
+
+def test_admit_failure_before_donation_spares_coresidents(params):
+    """A prefill failure happens BEFORE the cache is donated into _insert:
+    the failing request must error out alone while a co-resident decode
+    keeps streaming to the correct final result (ADVICE r1: one bad admit
+    must not take collateral requests down)."""
+    import time
+
+    prompt = [4, 8, 15]
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=64).start()
+    try:
+        h1 = engine.submit(prompt, 12)
+        while not h1.tokens and not h1.done.is_set():
+            time.sleep(0.005)  # wait until req1 is admitted and decoding
+        orig_prefill = engine._prefill
+
+        def bad_prefill(p, prompt_arr):
+            raise RuntimeError("synthetic prefill failure")
+
+        engine._prefill = bad_prefill
+        h2 = engine.submit([1, 2], 4)
+        with pytest.raises(RuntimeError, match="synthetic prefill failure"):
+            h2.result(timeout=60)
+        engine._prefill = orig_prefill
+        # co-resident request unharmed, still greedy-exact
+        assert h1.result(timeout=120) == reference_generate(params, prompt, 12)
+        # and the engine still serves new requests
+        h3 = engine.submit([7, 7], 3)
+        assert h3.result(timeout=120) == reference_generate(params, [7, 7], 3)
+    finally:
+        engine.stop()
+
+
+def test_admit_failure_after_donation_recovers_engine(params):
+    """If _insert dies AFTER consuming the donated cache, in-flight K/V is
+    unrecoverable: those requests must fail fast (not hang) and the engine
+    must rebuild a fresh cache and keep serving."""
+    import time
+
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=64).start()
+    try:
+        h1 = engine.submit([4, 8, 15], 40)
+        while not h1.tokens and not h1.done.is_set():
+            time.sleep(0.005)
+
+        orig_insert = engine._insert
+        calls = []
+
+        def bad_insert(cache, k1, v1, slot_idx):
+            if not calls:  # die once, then behave — models a transient
+                calls.append(1)  # device error mid-admission
+                for a in cache.values():  # simulate the donated-then-
+                    a.delete()  # crashed state deterministically
+                raise RuntimeError("insert died")  # (CPU jit ignores donation)
+            return orig_insert(cache, k1, v1, slot_idx)
+
+        engine._insert = bad_insert
+        h2 = engine.submit([1, 2], 4)
+        h3 = engine.submit([9, 9, 9], 3)  # queued/later — must NOT be
+        with pytest.raises(RuntimeError, match="insert died"):  # collateral
+            h2.result(timeout=60)
+        # co-resident request was failed, not wedged
+        with pytest.raises(RuntimeError, match="kv cache lost"):
+            h1.result(timeout=60)
+        # the never-admitted request is served from the rebuilt cache
+        assert h3.result(timeout=120) == reference_generate(params, [9, 9, 9], 3)
+    finally:
+        engine.stop()
