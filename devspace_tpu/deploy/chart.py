@@ -80,10 +80,28 @@ def render_value(value: Any, context: dict) -> Any:
     return value
 
 
+def chart_meta_path(chart_path: str) -> Optional[str]:
+    """Path of the chart's metadata file: ``chart.yaml`` (our dialect) or
+    ``Chart.yaml`` (upstream Helm naming — reference loads real Helm
+    charts, pkg/devspace/helm/install.go:54)."""
+    for name in ("chart.yaml", "Chart.yaml"):
+        p = os.path.join(chart_path, name)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def is_helm_chart(chart_path: str) -> bool:
+    """Helm-style charts use capital-C ``Chart.yaml`` and Go templates."""
+    return os.path.isfile(os.path.join(chart_path, "Chart.yaml")) and not os.path.isfile(
+        os.path.join(chart_path, "chart.yaml")
+    )
+
+
 def load_chart(chart_path: str) -> dict:
-    meta_path = os.path.join(chart_path, "chart.yaml")
-    if not os.path.isfile(meta_path):
-        raise ChartError(f"not a chart: {chart_path} (no chart.yaml)")
+    meta_path = chart_meta_path(chart_path)
+    if meta_path is None:
+        raise ChartError(f"not a chart: {chart_path} (no chart.yaml/Chart.yaml)")
     with open(meta_path, "r", encoding="utf-8") as fh:
         return yaml.safe_load(fh) or {}
 
@@ -121,21 +139,39 @@ def render_chart(
     # Vendored packages (deploy/packages.py add_package): each renders with
     # its own defaults overridden by the parent's values.packages.<name>,
     # sharing the release/extra context so its pods join the same release.
-    packages_dir = os.path.join(chart_path, "packages")
-    if os.path.isdir(packages_dir):
-        for pkg_name in sorted(os.listdir(packages_dir)):
-            pkg_dir = os.path.join(packages_dir, pkg_name)
-            if not os.path.isfile(os.path.join(pkg_dir, "chart.yaml")):
+    # Helm-style vendored dependencies live in charts/ with values scoped
+    # under values.<name> (helm subchart semantics); ours in packages/
+    # scoped under values.packages.<name>. A helm-style parent handles its
+    # own charts/ inside _render_helm_templates (shared define namespace,
+    # dependency condition gating), so skip that subdir here.
+    subdirs = (
+        (("packages", "packages"),)
+        if is_helm_chart(chart_path)
+        else (("packages", "packages"), ("charts", None))
+    )
+    for subdir, scope in subdirs:
+        base = os.path.join(chart_path, subdir)
+        if not os.path.isdir(base):
+            continue
+        for pkg_name in sorted(os.listdir(base)):
+            pkg_dir = os.path.join(base, pkg_name)
+            if chart_meta_path(pkg_dir) is None:
                 continue
             pkg_values: dict = {}
             pkg_defaults = os.path.join(pkg_dir, "values.yaml")
             if os.path.isfile(pkg_defaults):
                 with open(pkg_defaults, "r", encoding="utf-8") as fh:
                     pkg_values = yaml.safe_load(fh) or {}
-            overrides = (merged_values.get("packages") or {}).get(pkg_name) or {}
+            if scope:
+                overrides = (merged_values.get(scope) or {}).get(pkg_name) or {}
+            else:
+                overrides = merged_values.get(pkg_name) or {}
+            sub_values = merge(pkg_values, overrides)
+            if scope is None and "global" in merged_values:
+                sub_values = merge(sub_values, {"global": merged_values["global"]})
             pkg_context = {
                 **context,
-                "values": merge(pkg_values, overrides),
+                "values": sub_values,
                 "chart": load_chart(pkg_dir),
             }
             manifests.extend(
@@ -150,6 +186,8 @@ def render_chart(
 def _render_templates(
     chart_path: str, context: dict, release_name: str, namespace: str
 ) -> list[dict]:
+    if is_helm_chart(chart_path):
+        return _render_helm_templates(chart_path, context, release_name, namespace)
     manifests: list[dict] = []
     template_dir = os.path.join(chart_path, "templates")
     for path in sorted(glob.glob(os.path.join(template_dir, "*.yaml"))) + sorted(
@@ -172,6 +210,181 @@ def _render_templates(
             labels.setdefault("devspace.tpu/release", release_name)
             manifests.append(rendered)
     return manifests
+
+
+def _dependency_enabled(dep: dict, parent_values: dict) -> bool:
+    """Helm dependency gating: ``enabled:`` and ``condition:`` (a comma list
+    of value paths; the first that exists wins, default true)."""
+    if dep.get("enabled") is False:
+        return False
+    cond = dep.get("condition")
+    if not cond:
+        return True
+    for path in str(cond).split(","):
+        cur: Any = parent_values
+        for part in path.strip().split("."):
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                cur = None
+                break
+        if cur is not None:
+            return bool(cur)
+    return True
+
+
+def _helm_chart_tree(
+    chart_path: str, values: dict, meta: dict
+) -> list[tuple[str, dict, dict]]:
+    """(dir, scoped_values, meta) for a helm chart and its *enabled*
+    ``charts/`` dependencies, recursively. Subchart values follow helm
+    semantics: subchart defaults < parent's ``values.<name>``, with the
+    parent's ``global`` passed through; ``dependencies:`` in Chart.yaml
+    (or requirements.yaml) gate via condition/enabled."""
+    out = [(chart_path, values, meta)]
+    charts_dir = os.path.join(chart_path, "charts")
+    if not os.path.isdir(charts_dir):
+        return out
+    deps_meta: dict[str, dict] = {}
+    for dep in meta.get("dependencies") or []:
+        if dep.get("name"):
+            deps_meta[dep["name"]] = dep
+    req_path = os.path.join(chart_path, "requirements.yaml")
+    if os.path.isfile(req_path):
+        with open(req_path, "r", encoding="utf-8") as fh:
+            for dep in (yaml.safe_load(fh) or {}).get("dependencies") or []:
+                if dep.get("name"):
+                    deps_meta.setdefault(dep["name"], dep)
+    for sub_name in sorted(os.listdir(charts_dir)):
+        sub_dir = os.path.join(charts_dir, sub_name)
+        if chart_meta_path(sub_dir) is None:
+            continue
+        sub_meta = load_chart(sub_dir)
+        dep_name = sub_meta.get("name", sub_name)
+        if not _dependency_enabled(deps_meta.get(dep_name, {}), values):
+            continue
+        sub_values: dict = {}
+        sub_defaults = os.path.join(sub_dir, "values.yaml")
+        if os.path.isfile(sub_defaults):
+            with open(sub_defaults, "r", encoding="utf-8") as fh:
+                sub_values = yaml.safe_load(fh) or {}
+        sub_values = merge(sub_values, values.get(dep_name) or {})
+        if "global" in values:
+            sub_values = merge(sub_values, {"global": values["global"]})
+        out.extend(_helm_chart_tree(sub_dir, sub_values, sub_meta))
+    return out
+
+
+def _is_hook_manifest(doc: dict) -> bool:
+    annotations = (doc.get("metadata") or {}).get("annotations") or {}
+    return any(str(k).startswith("helm.sh/hook") for k in annotations)
+
+
+def _render_helm_templates(
+    chart_path: str, context: dict, release_name: str, namespace: str
+) -> list[dict]:
+    """Render an upstream-style Helm chart: Go templates under
+    ``templates/`` (incl. ``_helpers.tpl`` defines), the standard
+    ``.Values/.Release/.Chart/.Capabilities`` context. The runtime trio
+    the deployer injects (images / tpu / pullSecrets) is exposed as Helm
+    *values*, exactly where the reference injects the same trio
+    (deploy/helm/deploy.go:154-161).
+
+    All charts in the tree (parent + enabled charts/ dependencies) share
+    ONE define namespace, like helm's single template engine — library
+    charts whose only content is _helpers defines work. ``templates/
+    tests/`` and ``helm.sh/hook``-annotated manifests are skipped (helm
+    runs those only under `helm test` / at hook points, not on install)."""
+    from .gotemplate import Renderer, TemplateError
+
+    meta = context.get("chart") or {}
+    values = dict(context.get("values") or {})
+    for key in ("images", "tpu", "pullSecrets"):
+        if key in context and key not in values:
+            values[key] = context[key]
+
+    tree = _helm_chart_tree(chart_path, values, meta)
+    renderer = Renderer(seed=f"{release_name}/{namespace}")
+    # (template-key, helm_ctx, display_path) for non-helper templates
+    sources: list[tuple[str, dict, str]] = []
+    release_ctx = {
+        "Name": release_name,
+        "Namespace": namespace,
+        "Service": "devspace-tpu",
+        "IsInstall": True,
+        "IsUpgrade": False,
+        "Revision": 1,
+    }
+    capabilities = {
+        "KubeVersion": {"Version": "v1.27.0", "Major": "1", "Minor": "27"},
+        "APIVersions": _APIVersions(),
+    }
+    for sub_dir, sub_values, sub_meta in tree:
+        helm_ctx = {
+            "Values": sub_values,
+            "Release": release_ctx,
+            # Helm exposes metadata with capitalized field names
+            "Chart": {str(k)[:1].upper() + str(k)[1:]: v for k, v in sub_meta.items()},
+            "Capabilities": capabilities,
+        }
+        template_dir = os.path.join(sub_dir, "templates")
+        for path in sorted(
+            glob.glob(os.path.join(template_dir, "**", "*"), recursive=True)
+        ):
+            base = os.path.basename(path)
+            if not os.path.isfile(path) or base == "NOTES.txt":
+                continue
+            if not base.endswith((".yaml", ".yml", ".tpl")):
+                continue
+            rel = os.path.relpath(path, template_dir)
+            key = os.path.relpath(path, chart_path)
+            with open(path, "r", encoding="utf-8") as fh:
+                try:
+                    renderer.load(key, fh.read())
+                except TemplateError as e:
+                    raise ChartError(f"{path}: {e}") from e
+            if base.startswith("_"):  # _helpers.tpl etc: defines only
+                continue
+            if rel.split(os.sep)[0] == "tests":  # helm test templates
+                continue
+            sources.append((key, helm_ctx, path))
+    manifests: list[dict] = []
+    for key, helm_ctx, path in sources:
+        try:
+            out = renderer.execute(key, helm_ctx)
+        except TemplateError as e:
+            raise ChartError(f"{path}: {e}") from e
+        try:
+            docs = list(yaml.safe_load_all(out))
+        except yaml.YAMLError as e:
+            raise ChartError(
+                f"{path}: rendered to invalid YAML: {e}\n--- rendered ---\n{out}"
+            ) from e
+        for doc in docs:
+            if not doc:
+                continue
+            if not isinstance(doc, dict) or "kind" not in doc:
+                raise ChartError(f"{path}: rendered doc has no kind")
+            if _is_hook_manifest(doc):
+                continue
+            doc.setdefault("metadata", {}).setdefault("namespace", namespace)
+            labels = doc["metadata"].setdefault("labels", {})
+            labels.setdefault("devspace.tpu/release", release_name)
+            manifests.append(doc)
+    return manifests
+
+
+class _APIVersions:
+    """``.Capabilities.APIVersions``: iterable of versions with a ``Has``
+    method callable from templates."""
+
+    _versions = ("v1", "apps/v1", "batch/v1", "networking.k8s.io/v1")
+
+    def __iter__(self):
+        return iter(self._versions)
+
+    def Has(self, version: str) -> bool:  # noqa: N802 — helm casing
+        return version in self._versions
 
 
 class ChartDeployer:

@@ -87,13 +87,19 @@ def load_index(repo: str) -> dict[str, list[ChartEntry]]:
     for name, versions in (raw.get("entries") or {}).items():
         entries = []
         for v in versions or []:
+            # upstream helm index.yaml carries a `urls:` list per version
+            # (helm/search.go searches the same structure); ours uses
+            # `archive:`/`path:` — accept both.
+            archive = v.get("archive")
+            if archive is None and v.get("urls"):
+                archive = v["urls"][0]
             entries.append(
                 ChartEntry(
                     name=name,
                     version=str(v.get("version", "0")),
                     description=v.get("description", ""),
                     path=v.get("path"),
-                    archive=v.get("archive"),
+                    archive=archive,
                 )
             )
         entries.sort(key=lambda e: _version_key(e.version), reverse=True)
@@ -160,7 +166,16 @@ def _fetch_chart(repo: str, entry: ChartEntry, dest: str) -> None:
         raise PackageError(
             f"chart '{entry.name}' {entry.version}: http repos need an 'archive' entry"
         )
-    blob = _read_repo_file(repo, entry.archive)
+    # `urls:` entries in upstream helm indexes may be absolute — fetch
+    # those verbatim (no re-quoting: signed/encoded URLs must not change)
+    if _is_url(entry.archive):
+        try:
+            with urllib.request.urlopen(entry.archive, timeout=30) as resp:
+                blob = resp.read()
+        except OSError as e:
+            raise PackageError(f"cannot read {entry.archive}: {e}") from e
+    else:
+        blob = _read_repo_file(repo, entry.archive)
     with tempfile.TemporaryDirectory() as tmp:
         tarball = os.path.join(tmp, "chart.tgz")
         with open(tarball, "wb") as fh:
@@ -180,8 +195,13 @@ def _fetch_chart(repo: str, entry: ChartEntry, dest: str) -> None:
             if len(entries) == 1 and os.path.isdir(os.path.join(extracted, entries[0]))
             else extracted
         )
-        if not os.path.isfile(os.path.join(root, "chart.yaml")):
-            raise PackageError(f"archive for '{entry.name}' contains no chart.yaml")
+        # accept our chart.yaml or upstream helm Chart.yaml naming
+        if not any(
+            os.path.isfile(os.path.join(root, n)) for n in ("chart.yaml", "Chart.yaml")
+        ):
+            raise PackageError(
+                f"archive for '{entry.name}' contains no chart.yaml/Chart.yaml"
+            )
         shutil.copytree(root, dest)
 
 
@@ -217,7 +237,9 @@ def add_package(
     into the parent values.yaml under ``packages.<name>`` so users can see
     and edit the knobs (reference appends README'd values the same way)."""
     log = logger or logutil.get_logger()
-    if not os.path.isfile(os.path.join(chart_dir, "chart.yaml")):
+    from .chart import chart_meta_path
+
+    if chart_meta_path(chart_dir) is None:
         raise PackageError(f"not a chart dir: {chart_dir}")
     entry = resolve(repo, name, version)
     dest = os.path.join(chart_dir, PACKAGES_DIR, name)
