@@ -272,9 +272,72 @@ def cmd_status(args) -> int:
             for s in spans[-30:]
         ]
         log.print_table(["SPAN", "DURATION", "RESULT", "PARENT"], rows)
-    else:  # sync — scrape the sync log (reference: status/sync.go regexes)
+    else:  # sync — structured status file + sync.log scrape fallback
         import json as _json
+        import time as _time
 
+        # Live per-session/per-worker view from the session-published
+        # status file (richer than the reference's sync.log regex scrape,
+        # cmd/status/sync.go:19-21,56-110).
+        status_file = os.path.join(ctx.root, ".devspace", "logs", "sync-status.json")
+        published: dict = {}
+        try:
+            with open(status_file, "r", encoding="utf-8") as fh:
+                published = _json.load(fh)
+        except (OSError, ValueError):
+            published = {}
+        if published:
+            rows = []
+            worker_rows = []
+            for key, st in sorted(published.items()):
+                stats = st.get("stats") or {}
+                age = _time.time() - (st.get("updated_at") or 0)
+                if st.get("error"):
+                    state = "Error"
+                elif st.get("running") and age < 600:
+                    state = "Active"  # age guard: killed -9 never unpublishes
+                else:
+                    state = "Stopped"
+                rows.append(
+                    [
+                        st.get("local_path", "?"),
+                        st.get("container_path", "?"),
+                        state,
+                        f"{age:.0f}s ago",
+                        str(stats.get("uploaded", 0)),
+                        str(stats.get("downloaded", 0)),
+                        str(
+                            stats.get("removed_remote", 0)
+                            + stats.get("removed_local", 0)
+                        ),
+                        str(stats.get("repaired", 0)),
+                    ]
+                )
+                for w in st.get("workers") or []:
+                    worker_rows.append(
+                        [
+                            w.get("worker", "?"),
+                            w.get("state", "?"),
+                            str(w.get("repairs", 0)),
+                            f"{w['verified_ago']:.0f}s ago"
+                            if w.get("verified_ago") is not None
+                            else "-",
+                            (w.get("last_error") or "-")[:60],
+                        ]
+                    )
+            log.print_table(
+                ["LOCAL", "CONTAINER", "STATUS", "ACTIVITY", "UP", "DOWN", "RM", "REPAIRED"],
+                rows,
+            )
+            log.print_table(
+                ["WORKER", "STATE", "REPAIRS", "VERIFIED", "LAST ERROR"],
+                worker_rows,
+            )
+            errs = [st["error"] for st in published.values() if st.get("error")]
+            if errs:
+                log.error("last error: %s", errs[-1])
+            return 0
+        # Fallback: scrape sync.log (sessions from older runs / no file)
         sync_log = os.path.join(ctx.root, ".devspace", "logs", "sync.log")
         entries = []
         try:

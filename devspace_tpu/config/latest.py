@@ -164,6 +164,9 @@ class SyncConfig:
     # TPU addition: "all" broadcasts uploads to every worker and treats
     # worker 0 as authoritative for downloads; "worker0" syncs one host.
     fan_out: Optional[str] = None
+    # Seconds between drift-verification passes over mirror workers
+    # (0 disables; default 30).
+    verify_interval: Optional[float] = None
 
 
 @dataclass

@@ -289,7 +289,8 @@ def _build_functions(renderer: "Renderer") -> dict[str, Callable]:
         "default": lambda d, v=None: v if _truthy(v) else d,
         "coalesce": lambda *a: next((x for x in a if _truthy(x)), None),
         "ternary": lambda t, f, c: t if _truthy(c) else f,
-        "required": lambda msg, v: v if v is not None else _fail(msg),
+        # helm's required fails on nil AND empty string
+        "required": lambda msg, v: v if v is not None and v != "" else _fail(msg),
         "fail": lambda msg: _fail(msg),
         "empty": lambda v: not _truthy(v),
         "not": lambda v: not _truthy(v),
@@ -790,12 +791,16 @@ def _field(obj: Any, path: str) -> Any:
     for part in path.split("."):
         if not part:
             continue
-        if part.startswith("_"):
-            raise TemplateError(f"illegal field name {part!r}")
         if isinstance(cur, dict):
+            # dict keys are data, not attributes — underscore keys are fine
+            # (sprig's `split` yields _0/_1/... keys)
             cur = cur.get(part)
         elif cur is None:
             return None
         else:
+            # attribute traversal can reach Python internals — block
+            # underscore names here (``__globals__`` -> builtins -> eval)
+            if part.startswith("_"):
+                raise TemplateError(f"illegal field name {part!r}")
             cur = getattr(cur, part, None)
     return cur

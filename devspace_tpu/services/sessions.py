@@ -64,6 +64,12 @@ def start_sync(
             container=container,
             fan_out=sc.fan_out or "all",
             verbose=verbose,
+            verify_interval=(
+                sc.verify_interval if sc.verify_interval is not None else 30.0
+            ),
+            status_path=os.path.join(
+                base_dir, ".devspace", "logs", "sync-status.json"
+            ),
         )
         mirror = logutil.get_file_logger("sync", root=os.path.join(base_dir, ".devspace"))
         session_logger = log

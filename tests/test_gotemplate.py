@@ -133,8 +133,17 @@ def test_dunder_traversal_rejected():
 
     with pytest.raises(TemplateError, match="illegal field"):
         render('{{ .o.m.__globals__ }}', {"o": Obj()})
-    with pytest.raises(TemplateError, match="illegal field"):
-        render('{{ ._private }}', {"_private": 1})
+    # dict keys are data, not attributes: underscore keys stay reachable
+    # (sprig's split produces _0/_1/... keys)
+    assert render('{{ (split "/" .s)._1 }}', {"s": "a/b"}) == "b"
+
+
+def test_required_rejects_empty_string():
+    assert render('{{ required "msg" .v }}', {"v": "x"}) == "x"
+    with pytest.raises(TemplateError, match="image is required"):
+        render('{{ required "image is required" .v }}', {"v": ""})
+    with pytest.raises(TemplateError, match="image is required"):
+        render('{{ required "image is required" .missing }}', {})
 
 
 def test_comment_containing_action_syntax():

@@ -490,3 +490,82 @@ def test_file_index_thread_safety():
     # analogue, reference: sync/file_index.go)
     expect_per_thread = len([i for i in range(300) if i % 3 != 0])
     assert len(index) == 8 * expect_per_thread + 8
+
+
+def test_drift_detection_repairs_corrupted_worker(tmp_path, cluster):
+    """VERDICT round-1 next #5: a non-authoritative worker whose tree
+    diverges WITHOUT its shell dying (in-container rm / rogue write) is
+    detected by the verify loop, repaired, and reported."""
+    session, local, workers = make_session(
+        tmp_path, cluster, n_workers=3, verify_interval=0.2
+    )
+    write_file(str(local / "train.py"), "x = 1\n")
+    write_file(str(local / "lib" / "util.py"), "y = 2\n")
+    session.start()
+    try:
+        w2 = cluster.translate_path(workers[2], "/app")
+        wait_for(
+            lambda: os.path.exists(os.path.join(w2, "lib", "util.py")),
+            msg="initial mirror to worker 2",
+        )
+        # corrupt worker 2 in-container: delete a synced file, alter
+        # another, and drop a rogue file — all without touching the shell
+        os.unlink(os.path.join(w2, "train.py"))
+        write_file(os.path.join(w2, "lib", "util.py"), "corrupted")
+        write_file(os.path.join(w2, "rogue.txt"), "not ours")
+        wait_for(
+            lambda: (
+                os.path.exists(os.path.join(w2, "train.py"))
+                and open(os.path.join(w2, "lib", "util.py")).read() == "y = 2\n"
+                and not os.path.exists(os.path.join(w2, "rogue.txt"))
+            ),
+            timeout=10,
+            msg="worker 2 repaired",
+        )
+        # reported: per-worker repair count + session stats
+        health = {h["worker"]: h for h in session.worker_health()}
+        assert health["w-2"]["state"] == "mirror"
+        assert health["w-2"]["repairs"] >= 3
+        assert session.stats["repaired"] >= 3
+        assert health["w-0"]["state"] == "authority"
+        # worker 0 (authority) must never be "repaired" by the verifier:
+        # its divergence is the downstream's business
+        assert health["w-0"]["repairs"] == 0
+        # other workers untouched
+        w1 = cluster.translate_path(workers[1], "/app")
+        assert open(os.path.join(w1, "train.py")).read() == "x = 1\n"
+    finally:
+        session.stop()
+
+
+def test_status_file_published_with_worker_health(tmp_path, cluster):
+    status_path = str(tmp_path / "logs" / "sync-status.json")
+    session, local, workers = make_session(
+        tmp_path, cluster, n_workers=2, verify_interval=0.2,
+        status_path=status_path,
+    )
+    write_file(str(local / "a.txt"), "a")
+    session.start()
+    try:
+        import json
+
+        def published_ok():
+            try:
+                with open(status_path) as fh:
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                return False
+            st = next(iter(data.values()), None)
+            return bool(st and st["workers"] and st["stats"]["uploaded"] >= 0)
+
+        wait_for(published_ok, msg="status file published")
+        with open(status_path) as fh:
+            st = next(iter(json.load(fh).values()))
+        states = {w["worker"]: w["state"] for w in st["workers"]}
+        assert states == {"w-0": "authority", "w-1": "mirror"}
+        assert st["error"] is None
+    finally:
+        session.stop()
+    # stop publishes a final snapshot (updated_at advances)
+    with open(status_path) as fh:
+        assert next(iter(json.load(fh).values()))["updated_at"] > 0
