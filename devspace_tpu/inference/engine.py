@@ -6,20 +6,27 @@ what turns the llama-inference example from a one-request-at-a-time server
 into a throughput engine.
 
 Design, TPU-first:
-- **Static shapes throughout**: the KV cache is preallocated at
-  ``[layers, max_slots, max_len, kv_heads, head_dim]`` and every decode
-  iteration runs ONE jitted step over all slots — empty slots just compute
-  masked garbage (their cost is already paid; admission fills them). No
-  recompilation ever happens during serving.
+- **Static shapes throughout**: every decode iteration runs ONE jitted
+  step over all slots — empty slots just compute masked garbage (their
+  cost is already paid; admission fills them). No recompilation ever
+  happens during serving.
+- **Paged KV cache** (vLLM-style): K/V lives in a block pool
+  ``[layers, n_blocks, block_size, kv_heads, head_dim]`` with per-slot
+  block tables, so HBM is bounded by the POOL size — not
+  ``max_slots x max_len`` preallocation. Blocks are allocated as
+  sequences grow; when the pool runs dry the youngest request is
+  preempted (recompute-style: requeued with its generated prefix) so
+  older requests always finish. Block 0 is scratch: unallocated table
+  entries and parked writes land there.
+- **Chunked prefill, interleaved** (Sarathi-style): prompts prefill in
+  bounded chunks (``prefill_chunk`` tokens per dispatch), one chunk per
+  scheduler iteration BETWEEN decode chunks — co-resident decodes keep
+  streaming while a long prompt is admitted, so inter-token latency is
+  bounded by the chunk budget rather than the full prompt length.
 - **Iteration-level scheduling** (the Orca/vLLM insight): new requests are
   admitted between decode iterations, not between requests, so a long
   generation does not block a short one — per-slot positions make every
   slot's causal mask independent.
-- **Bucketed prefill**: prompts are padded to power-of-two buckets and
-  prefit in ONE full-sequence forward pass (``forward(return_kv=True)``
-  — big MXU matmuls, not a token-by-token scan), then the K/V is
-  scattered into the engine cache — a handful of compilations total,
-  amortized across the process lifetime.
 - **Device-side sampling + chunked decode**: sampling (greedy or
   per-slot temperature) happens inside the jitted step, and up to
   ``chunk_max`` tokens are decoded per dispatch via ``lax.scan`` — one
@@ -36,6 +43,7 @@ optional EOS early stop.
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
@@ -44,6 +52,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models import transformer as tfm
 
@@ -130,10 +139,14 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ("req", "length", "remaining", "last_token")
+    __slots__ = (
+        "req", "length", "remaining", "last_token",
+        "ready", "prefill_pos", "prompt", "admitted_at",
+    )
 
     def __init__(self):
         self.req: Optional[Request] = None
+        self.ready = False
 
 
 class InferenceEngine:
@@ -141,7 +154,14 @@ class InferenceEngine:
 
     ``submit()`` is thread-safe and returns the Request whose ``result()``
     blocks until generation completes. ``start()`` spawns the scheduler
-    thread; ``stop()`` drains and joins it."""
+    thread; ``stop()`` drains and joins it.
+
+    ``block_size``/``n_blocks`` size the paged KV pool: HBM for K/V is
+    ``2 x layers x n_blocks x block_size x kv_heads x head_dim`` bytes
+    (x dtype). The default pool holds full capacity (every slot at
+    max_len); pass a smaller ``n_blocks`` to oversubscribe — short
+    prompts then cost only the blocks they touch, and the preemption
+    path bounds the worst case."""
 
     def __init__(
         self,
@@ -152,9 +172,12 @@ class InferenceEngine:
         mesh=None,
         model_axis: str = "model",
         chunk_max: int = 8,
+        block_size: int = 64,
+        n_blocks: Optional[int] = None,
+        prefill_chunk: int = 512,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
-        ``models.transformer.param_partition_spec`` and the KV cache is
+        ``models.transformer.param_partition_spec`` and the KV pool is
         sharded over its head dim on ``model_axis`` (requires
         ``n_kv_heads % mesh.shape[model_axis] == 0``); the decode jit then
         runs under GSPMD, which inserts the attention/FFN collectives.
@@ -164,8 +187,19 @@ class InferenceEngine:
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq_len
         self.mesh = mesh
+        self.block_size = int(block_size)
+        self.max_blocks = math.ceil(self.max_len / self.block_size)
+        # +1: block 0 is reserved scratch
+        full_capacity = 1 + max_slots * self.max_blocks
+        self.n_blocks = int(n_blocks) if n_blocks else full_capacity
+        if self.n_blocks < 1 + self.max_blocks:
+            raise ValueError(
+                f"n_blocks {self.n_blocks} cannot hold even one max_len "
+                f"sequence ({1 + self.max_blocks} needed)"
+            )
+        self.prefill_chunk = max(1, int(prefill_chunk))
         L, Hkv, D = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        cache_sharding = None
+        pool_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -187,7 +221,7 @@ class InferenceEngine:
                     f"n_kv_heads {Hkv} not divisible by mesh axis "
                     f"'{model_axis}' ({mesh.shape[model_axis]})"
                 )
-            cache_sharding = NamedSharding(
+            pool_sharding = NamedSharding(
                 mesh, P(None, None, None, model_axis, None)
             )
             self.params = jax.tree_util.tree_map(
@@ -196,27 +230,29 @@ class InferenceEngine:
                 tfm.param_partition_spec(cfg, model_axis=model_axis),
             )
 
-        def fresh_cache():
-            cache = {
-                "k": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
-                "v": jnp.zeros((L, max_slots, self.max_len, Hkv, D), cfg.dtype),
-            }
-            if cache_sharding is not None:
-                cache = {
-                    k: jax.device_put(v, cache_sharding)
-                    for k, v in cache.items()
+        def fresh_pool():
+            pool = tfm.init_paged_pool(cfg, self.n_blocks, self.block_size)
+            if pool_sharding is not None:
+                pool = {
+                    k: jax.device_put(v, pool_sharding) for k, v in pool.items()
                 }
-            return cache
+            return pool
 
-        self._fresh_cache = fresh_cache
-        self.cache = self._fresh_cache()
+        self._fresh_pool = fresh_pool
+        self.pool = fresh_pool()
+        # host-side allocator state
+        self._free_blocks: list[int] = list(range(1, self.n_blocks))
+        self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
+        self._nalloc = [0] * max_slots  # allocated blocks per slot
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
+        self._resume: list[Request] = []  # preempted, re-admit first
         # serving counters (read via stats(); mutated by the scheduler
         # thread and — for fail-outs — by stop(); read-atomic under the GIL)
         self._started_at = None  # set by start()
         self.requests_completed = 0
         self.requests_failed = 0
+        self.requests_preempted = 0
         self.tokens_generated = 0
         self._stop = threading.Event()
         # serializes submit's check+put against stop's set+drain, closing
@@ -225,8 +261,8 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
 
         # The per-slot decode core lives with the model (single source of
-        # truth for the layer math): models.transformer.decode_tokens.
-        # Donating the cache is what keeps this viable at scale — an
+        # truth for the layer math): models.transformer.decode_tokens_paged.
+        # Donating the pool is what keeps this viable at scale — an
         # undonated update would copy the multi-GB K/V buffers per token.
         # Sampling runs on device and n_steps tokens are decoded per
         # dispatch (lax.scan), so the host pays one round-trip per chunk.
@@ -235,7 +271,8 @@ class InferenceEngine:
 
         def decode_chunk(
             params,
-            cache,
+            pool,
+            tables,
             tokens,
             positions,
             temps,
@@ -246,8 +283,10 @@ class InferenceEngine:
             use_filters,
         ):
             def step(carry, _):
-                cache, tok, pos, keys = carry
-                logits, cache = tfm.decode_tokens(params, cache, tok, pos, cfg)
+                pool, tok, pos, keys = carry
+                logits, pool = tfm.decode_tokens_paged(
+                    params, pool, tables, tok, pos, cfg
+                )
                 split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
                 keys, subs = split[:, 0], split[:, 1]
                 if use_filters:
@@ -264,12 +303,16 @@ class InferenceEngine:
                     )(subs, logits, temps).astype(jnp.int32)
                     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     tok = jnp.where(temps > 0, sampled, greedy)
-                return (cache, tok, pos + 1, keys), tok
+                # parked slots (mid-prefill / empty) sit at position 0 of
+                # the all-zeros table (scratch block); the clamp keeps any
+                # position from indexing past its table
+                pos = jnp.minimum(pos + 1, self.max_len - 1)
+                return (pool, tok, pos, keys), tok
 
-            (cache, _, _, keys), toks = jax.lax.scan(
-                step, (cache, tokens, positions, keys), None, length=n_steps
+            (pool, _, _, keys), toks = jax.lax.scan(
+                step, (pool, tokens, positions, keys), None, length=n_steps
             )
-            return cache, keys, toks  # toks [n_steps, B]
+            return pool, keys, toks  # toks [n_steps, B]
 
         # one compile per (chunk size, filters on/off) — both static
         from functools import partial as _partial
@@ -283,37 +326,14 @@ class InferenceEngine:
             for filt in (False, True)
         }
 
-        def prefill(params, prompt):  # prompt [1, T_bucket]
-            # ONE full-sequence forward (big MXU matmuls) instead of a
-            # token-by-token decode scan — forward's return_kv hands back
-            # the roped per-layer K/V in exactly the cache layout. Cast to
-            # the cache dtype: params may be f32 while the cache is bf16.
-            logits, (k, v) = tfm.forward(params, prompt, self.cfg, return_kv=True)
-            return {
-                "k": k.astype(self.cfg.dtype),
-                "v": v.astype(self.cfg.dtype),
-            }, logits  # k/v [L, 1, T_bucket, Hkv, D]
-
-        # jit's own shape-keyed cache compiles once per prompt bucket
-        self._prefill = jax.jit(prefill)
-
-        def insert(cache, k1, v1, slot_idx):
-            # Write one prefilled sequence's K/V bucket into its slot, in
-            # place (donated). k1/v1: [L, bucket, Hkv, D]. Writing the pad
-            # tail too is safe: positions >= the true prompt length are
-            # overwritten by decode before the mask ever exposes them.
-            # slot_idx stays dynamic -> one compile per prompt bucket, not
-            # per (slot, length) pair.
-            return {
-                "k": jax.lax.dynamic_update_slice(
-                    cache["k"], k1[:, None], (0, slot_idx, 0, 0, 0)
-                ),
-                "v": jax.lax.dynamic_update_slice(
-                    cache["v"], v1[:, None], (0, slot_idx, 0, 0, 0)
-                ),
-            }
-
-        self._insert = jax.jit(insert, donate_argnums=0)
+        # chunked prefill: jit's shape-keyed cache compiles once per chunk
+        # bucket (power-of-two final chunks + the full prefill_chunk)
+        self._prefill_step_jit = jax.jit(
+            lambda params, pool, table, toks, offset: tfm.prefill_chunk_paged(
+                params, pool, table, toks, offset, self.cfg
+            ),
+            donate_argnums=1,
+        )
 
     # -- public api --------------------------------------------------------
     def submit(
@@ -367,10 +387,18 @@ class InferenceEngine:
         return {
             "requests_completed": self.requests_completed,
             "requests_failed": self.requests_failed,
+            "requests_preempted": self.requests_preempted,
             "tokens_generated": self.tokens_generated,
-            "active_slots": sum(1 for s in self.slots if s.req is not None),
+            "active_slots": sum(
+                1 for s in self.slots if s.req is not None and s.ready
+            ),
+            "prefilling_slots": sum(
+                1 for s in self.slots if s.req is not None and not s.ready
+            ),
             "max_slots": self.max_slots,
-            "queued": self.pending.qsize(),
+            "free_blocks": len(self._free_blocks),
+            "total_blocks": self.n_blocks - 1,
+            "queued": self.pending.qsize() + len(self._resume),
             "uptime_s": round(uptime, 1),
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
             if uptime > 0
@@ -387,17 +415,51 @@ class InferenceEngine:
         with self._submit_lock:
             self._fail_outstanding("engine stopped")
 
+    # -- block allocator ---------------------------------------------------
+    def _blocks_needed(self, slot_idx: int, upto: int) -> int:
+        """Blocks to add so slot covers logical positions [0, upto)."""
+        return max(0, math.ceil(upto / self.block_size) - self._nalloc[slot_idx])
+
+    def _alloc(self, slot_idx: int, upto: int) -> bool:
+        """Grow slot's table to cover [0, upto). False if pool exhausted."""
+        need = self._blocks_needed(slot_idx, upto)
+        if need > len(self._free_blocks):
+            return False
+        for _ in range(need):
+            blk = self._free_blocks.pop()
+            self._tables[slot_idx, self._nalloc[slot_idx]] = blk
+            self._nalloc[slot_idx] += 1
+        return True
+
+    def _free_slot_blocks(self, slot_idx: int) -> None:
+        n = self._nalloc[slot_idx]
+        self._free_blocks.extend(int(b) for b in self._tables[slot_idx, :n])
+        self._tables[slot_idx, :] = 0
+        self._nalloc[slot_idx] = 0
+
+    def _decode_tables(self) -> jax.Array:
+        """Block tables for the decode dispatch: mid-prefill and empty
+        slots get an all-zeros row so their garbage write lands in the
+        scratch block instead of clobbering prefilled K/V."""
+        t = self._tables.copy()
+        for i, s in enumerate(self.slots):
+            if s.req is None or not s.ready:
+                t[i, :] = 0
+        return jnp.asarray(t)
+
     # -- scheduler ---------------------------------------------------------
     def _fail_outstanding(self, reason: str, drain_queue: bool = True) -> None:
-        """Fail slot-resident requests (their K/V lives in the cache).
+        """Fail slot-resident requests (their K/V lives in the pool).
         ``drain_queue=False`` spares queued requests that were never
         admitted — after a cache loss they have no state to lose and a
-        rebuilt cache can still serve them; only stop() drains the queue."""
-        for slot in self.slots:
+        rebuilt pool can still serve them; only stop() drains the queue."""
+        for i, slot in enumerate(self.slots):
             req = slot.req  # snapshot: a live scheduler may race us when
             if req is None:  # stop()'s join timed out on a wedged dispatch
                 continue
             slot.req = None
+            slot.ready = False
+            self._free_slot_blocks(i)
             if req.done.is_set():
                 continue  # completed concurrently — don't double-count
             req.error = reason
@@ -405,6 +467,11 @@ class InferenceEngine:
             self.requests_failed += 1
         if not drain_queue:
             return
+        for req in self._resume:
+            req.error = reason
+            req.done.set()
+            self.requests_failed += 1
+        self._resume.clear()
         while True:
             try:
                 req = self.pending.get_nowait()
@@ -414,30 +481,30 @@ class InferenceEngine:
             req.done.set()
             self.requests_failed += 1
 
-    def _recover_cache_if_lost(self) -> None:
-        """After a failed _admit: self.cache may have been donated into
-        _insert without the reassignment happening. If the prefill raised
-        (the common failure) the cache was never donated and co-resident
-        requests are untouched; only when _insert itself died after
-        donation is the buffer gone — then in-flight requests' K/V is
-        unrecoverable, so fail them and rebuild, exactly like the decode
-        failure path."""
+    def _recover_pool_if_lost(self) -> None:
+        """After a failed prefill/decode dispatch: the pool may have been
+        donated into the failed call without the reassignment happening.
+        Then in-flight K/V is unrecoverable — fail slot-resident requests
+        and rebuild; queued requests are served from the fresh pool."""
         lost = False
         try:
-            lost = any(a.is_deleted() for a in self.cache.values())
+            lost = any(a.is_deleted() for a in self.pool.values())
         except AttributeError:  # non-jax.Array leaves (tests with numpy)
             lost = False
         if lost:
             self._fail_outstanding(
-                "kv cache lost in failed admission", drain_queue=False
+                "kv pool lost in failed dispatch", drain_queue=False
             )
-            self.cache = self._fresh_cache()
+            self.pool = self._fresh_pool()
+            self._free_blocks = list(range(1, self.n_blocks))
+            self._tables[:] = 0
+            self._nalloc = [0] * self.max_slots
 
     def _bucket(self, n: int) -> int:
         b = 1
         while b < n:
             b *= 2
-        return min(b, self.max_len)
+        return min(b, self.prefill_chunk)
 
     def _chunk_sizes(self) -> list[int]:
         sizes = [1]
@@ -453,31 +520,92 @@ class InferenceEngine:
                 best = k
         return best
 
-    def _admit(self, slot_idx: int, req: Request) -> None:
+    def _admit(self, slot_idx: int, req: Request) -> bool:
+        """Assign a slot and allocate blocks for the prompt. The actual
+        prefill happens chunk-by-chunk in the scheduler loop. Returns
+        False (leaving the request queued) when the pool can't hold the
+        prompt right now."""
+        prompt = req.prompt_ids + req.tokens  # tokens: preempted resume
+        if not self._alloc(slot_idx, len(prompt)):
+            return False
         slot = self.slots[slot_idx]
-        t = len(req.prompt_ids)
-        bucket = self._bucket(t)
-        prompt = jnp.asarray(
-            [req.prompt_ids + [0] * (bucket - t)], dtype=jnp.int32
-        )
-        cache1, logits = self._prefill(self.params, prompt)
-        self.cache = self._insert(
-            self.cache,
-            cache1["k"][:, 0, :bucket],
-            cache1["v"][:, 0, :bucket],
-            jnp.asarray(slot_idx, jnp.int32),
-        )
         slot.req = req
-        slot.length = t
-        slot.remaining = req.max_new_tokens
-        key = jax.random.PRNGKey(req.seed)
-        key, sub = jax.random.split(key)
-        self._keys = self._keys.at[slot_idx].set(key)
-        # first generated token comes from the last REAL prompt position
-        first = sample_logits(
-            sub, logits[0, t - 1], req.temperature, req.top_k, req.top_p
+        slot.prompt = prompt
+        slot.prefill_pos = 0
+        slot.ready = False
+        slot.length = len(prompt)
+        slot.remaining = req.max_new_tokens - len(req.tokens)
+        slot.admitted_at = time.monotonic()
+        return True
+
+    def _prefill_one_chunk(self, slot_idx: int) -> None:
+        """Advance one slot's prefill by at most ``prefill_chunk`` tokens
+        (ONE bounded dispatch). On the final chunk, sample the first
+        generated token."""
+        slot = self.slots[slot_idx]
+        req = slot.req
+        t = len(slot.prompt)
+        offset = slot.prefill_pos
+        remaining = t - offset
+        c = (
+            self.prefill_chunk
+            if remaining >= self.prefill_chunk
+            else self._bucket(remaining)
         )
-        self._emit(slot_idx, int(first))
+        # the chunk's positions offset..offset+c-1 must stay inside the
+        # slot's table span — an overshooting pad tail would clamp into
+        # the prompt's last allocated block and corrupt its K/V
+        t_alloc = self.max_blocks * self.block_size
+        c = min(c, t_alloc - offset)
+        real = min(remaining, c)
+        chunk = slot.prompt[offset : offset + real] + [0] * (c - real)
+        table = jnp.asarray(self._tables[slot_idx])
+        logits, self.pool = self._prefill_step_jit(
+            self.params,
+            self.pool,
+            table,
+            jnp.asarray(chunk, jnp.int32),
+            jnp.asarray(offset, jnp.int32),
+        )
+        slot.prefill_pos = offset + real
+        if slot.prefill_pos >= t:
+            # prefill complete: first token from the last REAL position
+            key = jax.random.PRNGKey(req.seed)
+            key, sub = jax.random.split(key)
+            self._keys = self._keys.at[slot_idx].set(key)
+            first = sample_logits(
+                sub, logits[real - 1], req.temperature, req.top_k, req.top_p
+            )
+            slot.ready = True
+            self._emit(slot_idx, int(first))
+
+    def _preempt_youngest(self, keep: Optional[int] = None) -> bool:
+        """Free the most recently admitted slot (ready OR mid-prefill),
+        requeueing its request (recompute-style preemption: the generated
+        prefix rides along as part of the next admission's prompt).
+        ``keep`` protects one slot; returns False with nothing left to
+        preempt. Since the pool always holds at least one max_len
+        sequence (enforced at init), a lone resident can always grow —
+        preemption cannot deadlock the allocator."""
+        candidates = [
+            (i, s)
+            for i, s in enumerate(self.slots)
+            if s.req is not None and i != keep
+        ]
+        if not candidates or (keep is None and len(candidates) <= 1):
+            return False  # never preempt the only runner
+        i, slot = max(candidates, key=lambda t: t[1].admitted_at)
+        self._preempt(i)
+        return True
+
+    def _preempt(self, i: int) -> None:
+        slot = self.slots[i]
+        req = slot.req
+        slot.req = None
+        slot.ready = False
+        self._free_slot_blocks(i)
+        self._resume.append(req)
+        self.requests_preempted += 1
 
     def _emit(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
@@ -492,74 +620,129 @@ class InferenceEngine:
         ):
             req.done.set()
             slot.req = None
+            slot.ready = False
+            self._free_slot_blocks(slot_idx)
             self.requests_completed += 1
+
+    def _next_pending(self) -> Optional[Request]:
+        if self._resume:
+            return self._resume.pop(0)
+        try:
+            return self.pending.get_nowait()
+        except queue.Empty:
+            return None
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             # admit as many pending requests as there are free slots
+            # (admission only reserves blocks — prefill is incremental)
             for i, slot in enumerate(self.slots):
                 if slot.req is not None:
                     continue
-                try:
-                    req = self.pending.get_nowait()
-                except queue.Empty:
+                req = self._next_pending()
+                if req is None:
                     break
                 try:
-                    self._admit(i, req)
+                    if not self._admit(i, req):
+                        # pool full — keep it queued at the front
+                        self._resume.insert(0, req)
+                        break
                 except Exception as e:  # noqa: BLE001 — surface per-request
                     req.error = str(e)
                     req.done.set()
                     self.slots[i].req = None
                     self.requests_failed += 1
-                    self._recover_cache_if_lost()
-            active = [i for i, s in enumerate(self.slots) if s.req is not None]
-            if not active:
-                # idle: block for the next request and admit it directly
-                # (re-enqueuing would push it behind later arrivals)
+                    self._recover_pool_if_lost()
+            prefilling = [
+                i
+                for i, s in enumerate(self.slots)
+                if s.req is not None and not s.ready
+            ]
+            ready = [
+                i for i, s in enumerate(self.slots) if s.req is not None and s.ready
+            ]
+            if not prefilling and not ready:
+                # idle: wait for work
                 try:
                     req = self.pending.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                self._resume.insert(0, req)
+                continue
+            # ONE bounded prefill chunk per iteration (round-robin over
+            # prefilling slots), so admission never starves decode
+            if prefilling:
+                i = prefilling[0]
                 try:
-                    self._admit(0, req)
+                    self._prefill_one_chunk(i)
                 except Exception as e:  # noqa: BLE001
-                    req.error = str(e)
-                    req.done.set()
-                    self.slots[0].req = None
-                    self.requests_failed += 1
-                    self._recover_cache_if_lost()
+                    slot = self.slots[i]
+                    req = slot.req
+                    slot.req = None
+                    slot.ready = False
+                    self._free_slot_blocks(i)
+                    if req is not None:
+                        req.error = str(e)
+                        req.done.set()
+                        self.requests_failed += 1
+                    self._recover_pool_if_lost()
+                if not ready:
+                    continue  # nothing to decode yet — keep prefilling
+            if not ready:
+                continue
+            # grow every ready slot's table to cover this decode chunk's
+            # writes; preempt youngest-first when the pool runs dry
+            want = max(self.slots[i].remaining for i in ready)
+            room = min(self.max_len - self.slots[i].length for i in ready)
+            k_steps = self._pick_chunk(max(1, min(want, room + 1)))
+            for i in list(ready):
+                s = self.slots[i]
+                # writes never pass max_len-1 (the decode scan clamps its
+                # positions), so coverage past max_len is never needed —
+                # and would index past the table row
+                need_upto = min(s.length + k_steps, self.max_len)
+                while not self._alloc(i, need_upto):
+                    if not self._preempt_youngest(keep=i):
+                        # nothing else to evict: requeue this slot itself
+                        # (a lone max_len resident always fits, so this
+                        # only fires when prefilling peers hold the pool)
+                        self._preempt(i)
+                        break
+                if s.req is None:  # got preempted itself
+                    ready.remove(i)
+            if not ready:
                 continue
             tokens = jnp.asarray(
                 [
-                    (s.last_token if s.req is not None else 0)
+                    (s.last_token if s.req is not None and s.ready else 0)
                     for s in self.slots
                 ],
                 dtype=jnp.int32,
             )
             positions = jnp.asarray(
                 [
-                    (s.length - 1 if s.req is not None else 0)
+                    (s.length - 1 if s.req is not None and s.ready else 0)
                     for s in self.slots
                 ],
                 dtype=jnp.int32,
             )
             temps = jnp.asarray(
                 [
-                    (s.req.temperature if s.req is not None else 0.0)
+                    (s.req.temperature if s.req is not None and s.ready else 0.0)
                     for s in self.slots
                 ],
                 dtype=jnp.float32,
             )
             top_ks = jnp.asarray(
                 [
-                    (s.req.top_k if s.req is not None else 0)
+                    (s.req.top_k if s.req is not None and s.ready else 0)
                     for s in self.slots
                 ],
                 dtype=jnp.int32,
             )
             top_ps = jnp.asarray(
                 [
-                    (s.req.top_p if s.req is not None else 1.0)
+                    (s.req.top_p if s.req is not None and s.ready else 1.0)
                     for s in self.slots
                 ],
                 dtype=jnp.float32,
@@ -571,26 +754,19 @@ class InferenceEngine:
             # Slots that finish mid-chunk (EOS or remaining=0) truncate
             # host-side; the overshoot compute is already paid by the
             # static batch. Only the max_len write bound is a hard clamp.
-            want = max(s.remaining for s in self.slots if s.req is not None)
-            room = min(
-                self.max_len - s.length
-                for s in self.slots
-                if s.req is not None
-            )
-            k_steps = self._pick_chunk(max(1, min(want, room + 1)))
-            # NOTE positions hold the index of the last emitted token: its
-            # K/V has not been written yet (prefill wrote only the prompt),
-            # so the decode step both writes it and attends through it.
             filters_on = any(
-                s.req is not None and (s.req.top_k > 0 or s.req.top_p < 1.0)
+                s.req is not None
+                and s.ready
+                and (s.req.top_k > 0 or s.req.top_p < 1.0)
                 for s in self.slots
             )
             try:
-                self.cache, self._keys, toks = self._decode_chunk[
+                self.pool, self._keys, toks = self._decode_chunk[
                     (k_steps, filters_on)
                 ](
                     self.params,
-                    self.cache,
+                    self.pool,
+                    self._decode_tables(),
                     tokens,
                     positions,
                     temps,
@@ -599,15 +775,18 @@ class InferenceEngine:
                     self._keys,
                 )
                 toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
-                for i in active:
+                for i in ready:
                     for j in range(k_steps):
                         if self.slots[i].req is None:
                             break  # finished mid-chunk; rest is speculative
                         self._emit(i, int(toks[j, i]))
             except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
-                # The cache was donated into the failed call and may be
+                # The pool was donated into the failed call and may be
                 # invalid; fail everything in flight rather than hang
-                # every caller, then rebuild a clean cache and keep
+                # every caller, then rebuild a clean pool and keep
                 # serving new requests.
                 self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
-                self.cache = self._fresh_cache()  # donated buffer is gone
+                self.pool = self._fresh_pool()
+                self._free_blocks = list(range(1, self.n_blocks))
+                self._tables[:] = 0
+                self._nalloc = [0] * self.max_slots

@@ -170,6 +170,27 @@ def default_attention(q, k, v, causal: bool = True):
     return full_attention(q, k, v, causal=causal)
 
 
+def layer_apply(h, layer: dict, cfg: TransformerConfig, cos, sin, attention_fn=None):
+    """One transformer layer (attn + SwiGLU FFN with pre-RMSNorm residuals)
+    -> (h', (k, v)). The single source of truth for the layer math, shared
+    by ``forward`` and the pipeline-parallel stage functions."""
+    attn = attention_fn or partial(default_attention, causal=True)
+    b, t, _ = h.shape
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+    q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (x @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    ctx = attn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
+    h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
+    x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
+    return h + (gated @ layer["w_down"]).astype(h.dtype), (k, v)
+
+
 # -- forward ----------------------------------------------------------------
 def forward(
     params: dict,
@@ -213,19 +234,10 @@ def forward(
     kv_out: list[tuple[jax.Array, jax.Array]] = []
 
     def layer_fn(h, layer, cos, sin):
-        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
-        q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
-        k = (x @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-        v = (x @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        h, (k, v) = layer_apply(h, layer, cfg, cos, sin, attention_fn=attn)
         if return_kv:
             kv_out.append((k, v))
-        ctx = attn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
-        h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
-        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
-        gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
-        return h + (gated @ layer["w_down"]).astype(h.dtype)
+        return h
 
     if remat:
         layer_fn = jax.checkpoint(layer_fn)
@@ -308,6 +320,150 @@ def decode_tokens(
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+# -- paged KV cache ---------------------------------------------------------
+# vLLM-style block-pool KV storage: HBM is bounded by the POOL size, not
+# max_slots x max_len. Per-slot block tables map logical positions to pool
+# blocks; attention gathers a slot's blocks back into a contiguous view.
+# The gather costs one extra cache read per step vs the dense layout — the
+# price of capacity oversubscription (a fused Pallas paged-attention kernel
+# can remove it later without changing this interface).
+
+
+def init_paged_pool(
+    cfg: TransformerConfig, n_blocks: int, block_size: int
+) -> dict:
+    """Block pool: {"k","v"} of [L, n_blocks, block_size, Hkv, D].
+    Block 0 is reserved as a scratch/garbage block by the engine (parked
+    writes land there; unallocated table entries point at it)."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _gather_pages(pool_layer, table):
+    """[n_blocks, bs, H, D] gathered by table [B, max_blocks] ->
+    [B, max_blocks*bs, H, D] (a slot's logical cache view)."""
+    b, mb = table.shape
+    _, bs, h, d = pool_layer.shape
+    return pool_layer[table].reshape(b, mb * bs, h, d)
+
+
+def decode_tokens_paged(
+    params: dict,
+    pool: dict,  # {"k","v"} [L, n_blocks, bs, Hkv, D]
+    tables: jax.Array,  # [B, max_blocks] int32 block ids
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 logical write position per sequence
+    cfg: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """``decode_tokens`` over a paged pool: identical math, but K/V reads
+    gather each slot's blocks and the new token's K/V scatters into
+    (table[pos // bs], pos % bs)."""
+    b = tokens.shape[0]
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    bs = pool["k"].shape[2]
+    t_alloc = tables.shape[1] * bs
+    cos, sin = rope_frequencies(cfg, positions)
+
+    def rope1(x):
+        return apply_rope(x, cos, sin, per_batch=True)
+
+    batch_idx = jnp.arange(b)
+    blk = tables[batch_idx, positions // bs]  # [B] pool block per sequence
+    off = positions % bs
+    h = params["embed"][tokens][:, None, :]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = (x @ layer["wq"]).reshape(b, 1, cfg.n_heads, hd)
+        k = (x @ layer["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        v = (x @ layer["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        q = rope1(q)
+        k = rope1(k)
+        k_pool = pool["k"][li].at[blk, off].set(k[:, 0])
+        v_pool = pool["v"][li].at[blk, off].set(v[:, 0])
+        new_k.append(k_pool)
+        new_v.append(v_pool)
+        keys = repeat_kv(_gather_pages(k_pool, tables), n_rep)
+        vals = repeat_kv(_gather_pages(v_pool, tables), n_rep)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = (jnp.arange(t_alloc)[None, :] <= positions[:, None])[
+            :, None, None, :
+        ]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vals).astype(h.dtype)
+        h = h + (ctx.reshape(b, 1, -1) @ layer["wo"]).astype(h.dtype)
+        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
+        h = h + (gated @ layer["w_down"]).astype(h.dtype)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def prefill_chunk_paged(
+    params: dict,
+    pool: dict,
+    table: jax.Array,  # [max_blocks] int32 — ONE slot's block table
+    tokens: jax.Array,  # [C] int32 chunk of the prompt (may be padded)
+    offset: jax.Array,  # scalar int32: logical position of tokens[0]
+    cfg: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """One prompt chunk of chunked prefill -> (logits [C, vocab], pool').
+
+    Computes the chunk's K/V at positions offset..offset+C-1, scatters
+    them into the slot's pool blocks, and attends with the block-causal
+    mask (every chunk token sees all cache positions <= its own). Chained
+    over chunks this prefitting is mathematically identical to the
+    full-sequence forward, but each dispatch is bounded by the chunk size
+    — the scheduler can interleave decode chunks between prompt chunks so
+    co-resident decodes keep streaming during a long admission
+    (Sarathi/vLLM-style chunked prefill). Pad-tail writes land at
+    positions >= the true prompt length; decode overwrites each position
+    in the same step that first attends to it, so they are never read."""
+    c = tokens.shape[0]
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    bs = pool["k"].shape[2]
+    t_alloc = table.shape[0] * bs
+    positions = offset + jnp.arange(c, dtype=jnp.int32)  # [C]
+    cos, sin = rope_frequencies(cfg, positions)
+    blk = table[positions // bs]  # [C]
+    off = positions % bs
+    h = params["embed"][tokens][None]  # [1, C, D]
+    cur_k, cur_v = pool["k"], pool["v"]
+    for li, layer in enumerate(params["layers"]):
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = (x @ layer["wq"]).reshape(1, c, cfg.n_heads, hd)
+        k = (x @ layer["wk"]).reshape(1, c, cfg.n_kv_heads, hd)
+        v = (x @ layer["wv"]).reshape(1, c, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        cur_k = cur_k.at[li, blk, off].set(k[0])
+        cur_v = cur_v.at[li, blk, off].set(v[0])
+        keys = repeat_kv(_gather_pages(cur_k[li], table[None]), n_rep)
+        vals = repeat_kv(_gather_pages(cur_v[li], table[None]), n_rep)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = (
+            jnp.arange(t_alloc)[None, :] <= positions[:, None]
+        )[None, None]  # [1, 1, C, T_alloc]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vals).astype(h.dtype)
+        h = h + (ctx.reshape(1, c, -1) @ layer["wo"]).astype(h.dtype)
+        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
+        h = h + (gated @ layer["w_down"]).astype(h.dtype)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h[0] @ params["lm_head"]).astype(jnp.float32)  # [C, vocab]
+    return logits, {"k": cur_k, "v": cur_v}
 
 
 def decode_step(

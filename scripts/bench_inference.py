@@ -85,6 +85,75 @@ def main():
         file=sys.stderr,
     )
 
+    # inter-token latency under admission load (VERDICT r1 next #3): a
+    # streaming request's token gaps while a LONG prompt is admitted
+    # mid-stream — chunked prefill keeps the gap bounded by the chunk
+    # budget, not the whole prompt.
+    engine = InferenceEngine(
+        params,
+        CFG,
+        max_slots=4,
+        max_len=512,
+        chunk_max=4,
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
+    ).start()
+    try:
+        warm = engine.submit(prompts[0], 16)
+        warm.result(timeout=600)  # compile decode + small prefill buckets
+        long_prompt = list(rng.integers(1, 1000, size=384))
+        warm2 = engine.submit(long_prompt[:256], 2)  # compile big buckets
+        warm2.result(timeout=600)
+
+        stream_req = engine.submit(prompts[1], 96)
+        gaps, last = [], None
+        admitted = False
+        for _ in stream_req.stream(timeout=600):
+            now = time.time()
+            if last is not None:
+                gaps.append(now - last)
+            last = now
+            if not admitted and len(gaps) >= 8:
+                engine.submit(long_prompt, 8)  # admit mid-stream
+                admitted = True
+        gaps_during = sorted(gaps[8:]) or [0.0]
+        p50 = gaps_during[len(gaps_during) // 2]
+        p95 = gaps_during[int(len(gaps_during) * 0.95) - 1]
+        mx = gaps_during[-1]
+    finally:
+        engine.stop()
+    print(
+        f"[inf-bench] inter-token gap during long-prompt admission: "
+        f"p50 {p50*1000:.1f}ms p95 {p95*1000:.1f}ms max {mx*1000:.1f}ms",
+        file=sys.stderr,
+    )
+
+    import json
+
+    print(
+        json.dumps(
+            {
+                "metric": "serving_continuous_batching_tok_per_sec",
+                "value": round(total_new / engine_s, 1),
+                "unit": "tok/s",
+                "vs_serial_generate": round(serial_s / engine_s, 2),
+                "serial_tok_per_sec": round(total_new / serial_s, 1),
+                "intertoken_during_admission_ms": {
+                    "p50": round(p50 * 1000, 1),
+                    "p95": round(p95 * 1000, 1),
+                    "max": round(mx * 1000, 1),
+                },
+                "config": {
+                    "dim": CFG.dim,
+                    "layers": CFG.n_layers,
+                    "new_tokens": NEW_TOKENS,
+                    "requests": N_REQ,
+                    "prefill_chunk": int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
+                    "paged_kv_block": 64,
+                },
+            }
+        )
+    )
+
 
 if __name__ == "__main__":
     main()

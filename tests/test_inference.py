@@ -277,10 +277,10 @@ def test_engine_stats_counters(params):
 
 
 def test_admit_failure_before_donation_spares_coresidents(params):
-    """A prefill failure happens BEFORE the cache is donated into _insert:
-    the failing request must error out alone while a co-resident decode
-    keeps streaming to the correct final result (ADVICE r1: one bad admit
-    must not take collateral requests down)."""
+    """A prefill failure happens BEFORE the pool is donated into the
+    prefill dispatch: the failing request must error out alone while a
+    co-resident decode keeps streaming to the correct final result
+    (ADVICE r1: one bad admit must not take collateral requests down)."""
     import time
 
     prompt = [4, 8, 15]
@@ -289,16 +289,16 @@ def test_admit_failure_before_donation_spares_coresidents(params):
         h1 = engine.submit(prompt, 12)
         while not h1.tokens and not h1.done.is_set():
             time.sleep(0.005)  # wait until req1 is admitted and decoding
-        orig_prefill = engine._prefill
+        orig = engine._prefill_step_jit
 
-        def bad_prefill(p, prompt_arr):
+        def bad_prefill(*args):
             raise RuntimeError("synthetic prefill failure")
 
-        engine._prefill = bad_prefill
+        engine._prefill_step_jit = bad_prefill
         h2 = engine.submit([1, 2], 4)
         with pytest.raises(RuntimeError, match="synthetic prefill failure"):
             h2.result(timeout=60)
-        engine._prefill = orig_prefill
+        engine._prefill_step_jit = orig
         # co-resident request unharmed, still greedy-exact
         assert h1.result(timeout=120) == reference_generate(params, prompt, 12)
         # and the engine still serves new requests
@@ -309,9 +309,9 @@ def test_admit_failure_before_donation_spares_coresidents(params):
 
 
 def test_admit_failure_after_donation_recovers_engine(params):
-    """If _insert dies AFTER consuming the donated cache, in-flight K/V is
-    unrecoverable: those requests must fail fast (not hang) and the engine
-    must rebuild a fresh cache and keep serving."""
+    """If the prefill dispatch dies AFTER consuming the donated pool,
+    in-flight K/V is unrecoverable: those requests must fail fast (not
+    hang) and the engine must rebuild a fresh pool and keep serving."""
     import time
 
     engine = InferenceEngine(params, CFG, max_slots=2, max_len=64).start()
@@ -320,26 +320,107 @@ def test_admit_failure_after_donation_recovers_engine(params):
         while not h1.tokens and not h1.done.is_set():
             time.sleep(0.005)
 
-        orig_insert = engine._insert
+        orig = engine._prefill_step_jit
         calls = []
 
-        def bad_insert(cache, k1, v1, slot_idx):
+        def bad_prefill(params_, pool, *rest):
             if not calls:  # die once, then behave — models a transient
                 calls.append(1)  # device error mid-admission
-                for a in cache.values():  # simulate the donated-then-
+                for a in pool.values():  # simulate the donated-then-
                     a.delete()  # crashed state deterministically
-                raise RuntimeError("insert died")  # (CPU jit ignores donation)
-            return orig_insert(cache, k1, v1, slot_idx)
+                raise RuntimeError("prefill died")  # (CPU jit ignores donation)
+            return orig(params_, pool, *rest)
 
-        engine._insert = bad_insert
+        engine._prefill_step_jit = bad_prefill
         h2 = engine.submit([1, 2], 4)
         h3 = engine.submit([9, 9, 9], 3)  # queued/later — must NOT be
-        with pytest.raises(RuntimeError, match="insert died"):  # collateral
+        with pytest.raises(RuntimeError, match="prefill died"):  # collateral
             h2.result(timeout=60)
         # co-resident request was failed, not wedged
-        with pytest.raises(RuntimeError, match="kv cache lost"):
+        with pytest.raises(RuntimeError, match="kv pool lost"):
             h1.result(timeout=60)
-        # the never-admitted request is served from the rebuilt cache
+        # the never-admitted request is served from the rebuilt pool
         assert h3.result(timeout=120) == reference_generate(params, [9, 9, 9], 3)
+    finally:
+        engine.stop()
+
+
+def test_decode_streams_during_long_prompt_admission(params):
+    """VERDICT r1 next #3: admitting a long prompt must not stall
+    co-resident decodes. With chunked prefill (tiny chunks here), the
+    active request keeps receiving tokens BETWEEN the new prompt's
+    chunks — before the long request produces its first token."""
+    import time
+
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=128, prefill_chunk=4, chunk_max=2
+    ).start()
+    try:
+        h1 = engine.submit([5, 6, 7], 60)
+        while len(h1.tokens) < 2 and not h1.done.is_set():
+            time.sleep(0.005)  # h1 is decoding
+        n_before = len(h1.tokens)
+        long_prompt = list(range(1, 49))  # 48 tokens = 12 prefill chunks
+        h2 = engine.submit(long_prompt, 4)
+        # watch h1 progress while h2 is still prefilling
+        grew = 0
+        deadline = time.monotonic() + 120
+        while not h2.tokens and time.monotonic() < deadline:
+            grew = len(h1.tokens) - n_before
+            if h2.done.is_set():
+                break
+            time.sleep(0.005)
+        assert grew >= 2, (
+            f"co-resident decode stalled during admission (grew {grew})"
+        )
+        # and both still produce greedy-exact output
+        assert h1.result(timeout=180) == reference_generate(params, [5, 6, 7], 60)
+        assert h2.result(timeout=180) == reference_generate(params, long_prompt, 4)
+    finally:
+        engine.stop()
+
+
+def test_paged_pool_preemption_and_recovery(params):
+    """An oversubscribed pool (n_blocks < full capacity) preempts the
+    youngest request when blocks run out; the preempted request is
+    re-admitted (recompute-style) and still completes greedy-exact."""
+    p1 = [2, 3, 4, 5]
+    p2 = [9, 8, 7]
+    # block_size 8, max_len 64 -> 8 blocks per full sequence; pool of 9
+    # usable blocks can hold one full sequence + one block — guaranteed
+    # contention between two 40+-position sequences
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64,
+        block_size=8, n_blocks=10, prefill_chunk=8,
+    ).start()
+    try:
+        h1 = engine.submit(p1, 40)
+        h2 = engine.submit(p2, 40)
+        r1 = h1.result(timeout=300)
+        r2 = h2.result(timeout=300)
+        assert r1 == reference_generate(params, p1, 40)
+        assert r2 == reference_generate(params, p2, 40)
+        assert engine.stats()["requests_preempted"] >= 1
+        assert engine.stats()["requests_completed"] == 2
+        # all blocks returned to the free list
+        assert engine.stats()["free_blocks"] == engine.stats()["total_blocks"]
+    finally:
+        engine.stop()
+
+
+def test_full_window_request_with_coresident_long_decode(params):
+    """Allocation boundary regression: a slot whose sequence fills its
+    whole max_len window, co-resident with a long-running decode, must
+    not drive the allocator past the table row (which killed the
+    scheduler thread and hung every caller)."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, block_size=8
+    ).start()
+    try:
+        h_long = engine.submit([1, 2, 3], 50)  # long decode keeps want high
+        prompt = list(np.random.default_rng(1).integers(1, 200, size=61))
+        h_full = engine.submit(prompt, 3)  # 61 + 3 = 64 = max_len exactly
+        assert h_full.result(timeout=300) == reference_generate(params, prompt, 3)
+        assert h_long.result(timeout=300) == reference_generate(params, [1, 2, 3], 50)
     finally:
         engine.stop()
