@@ -109,3 +109,342 @@ def stack_stage_params(param_list):
     """Stack per-stage pytrees into the leading-stage-dim layout that
     pipeline_apply expects (shard the result over the pipe axis)."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B pipeline training for the transformer (heterogeneous end-to-end)
+# ---------------------------------------------------------------------------
+# VERDICT r1 next #4: a REAL model — embedding -> n_stages groups of
+# transformer layers (stage-sharded over `pipe`) -> final-norm + LM head —
+# trained with the one-forward-one-backward schedule, not GPipe-via-grad.
+#
+# Schedule (PipeDream-flush / non-interleaved 1F1B), mapped onto a global
+# tick clock so the whole thing is ONE lax.scan under shard_map:
+#   stage s runs forward  f at tick  tau = s + 2f                (f < M)
+#   stage s runs backward b at tick  tau = 2S - 1 - s + 2b       (b < M)
+# F and B ticks have opposite parity per device, so each tick a device
+# does exactly one of {F, B, idle} — selected with lax.cond (the branches
+# contain no collectives; the ppermute hops run unconditionally each tick,
+# carrying zeros when nothing was produced — the receiver only reads a
+# channel on the tick the schedule says a real value arrives).
+#
+# Why embed/head are replicated, not stages: they are not in the
+# steady-state loop. Embedding is a gather (computed by stage 0's F tick);
+# head+loss run inside the LAST stage's B tick — that is what makes the
+# schedule 1F1B: microbatch m's backward starts the tick after its forward
+# leaves the last stage, bounding stored activations at S - s microbatches
+# per device (ring buffer) instead of GPipe's M.
+#
+# Backward recomputes the stage forward (activation recomputation): the
+# ring stores only stage INPUTS; jax.vjp re-runs the K-layer group on the
+# B tick. Grads: stage grads stay sharded over `pipe`; embed/head grads
+# are nonzero on one stage and psum'd over `pipe` to all.
+
+
+def transformer_stage_params(params: dict, n_stages: int) -> dict:
+    """Split standard transformer params (models.transformer.init_params)
+    into the pipeline layout: {"embed", "stages" [S, K, ...], "final_norm",
+    "lm_head"} with K = n_layers / n_stages."""
+    n_layers = len(params["layers"])
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    k = n_layers // n_stages
+    groups = [
+        stack_stage_params(params["layers"][s * k : (s + 1) * k])
+        for s in range(n_stages)
+    ]
+    return {
+        "embed": params["embed"],
+        "stages": stack_stage_params(groups),  # [S, K, ...]
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def transformer_unstage_params(stage_params: dict) -> dict:
+    """Inverse of transformer_stage_params."""
+    stages = stage_params["stages"]
+    s = jax.tree_util.tree_leaves(stages)[0].shape[0]
+    k = jax.tree_util.tree_leaves(stages)[0].shape[1]
+    layers = []
+    for si in range(s):
+        for ki in range(k):
+            layers.append(
+                jax.tree_util.tree_map(lambda p: p[si, ki], stages)
+            )
+    return {
+        "embed": stage_params["embed"],
+        "layers": layers,
+        "final_norm": stage_params["final_norm"],
+        "lm_head": stage_params["lm_head"],
+    }
+
+
+def pipeline_lm_loss_and_grads(
+    mesh: Mesh,
+    cfg,
+    n_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str = None,
+):
+    """Build ``f(stage_params, tokens) -> (loss, grads)`` running the
+    transformer forward+backward under the 1F1B schedule.
+
+    ``tokens``: [M, mb, T+1] int32 (next-token LM: inputs are [:, :, :-1],
+    targets [:, :, 1:]); M must equal ``n_microbatches``. ``stage_params``
+    from transformer_stage_params, sharded over ``axis``. With
+    ``data_axis`` set, the microbatch dim (mb) is additionally sharded
+    over that mesh axis (PP x DP); loss/grads are psum'd accordingly.
+    Returns the mean loss over all microbatches and a grads pytree shaped
+    like stage_params."""
+    from ..models.transformer import (
+        layer_apply,
+        rms_norm,
+        rope_frequencies,
+    )
+    from ..ops.losses import fused_cross_entropy
+
+    n_stages = mesh.shape[axis]
+    m_total = n_microbatches
+
+    def local_fn(stage_params, tokens):
+        stage = jax.lax.axis_index(axis)
+        stages = jax.tree_util.tree_map(lambda p: p[0], stage_params["stages"])
+        embed = stage_params["embed"]
+        final_norm = stage_params["final_norm"]
+        lm_head = stage_params["lm_head"]
+        inputs = tokens[:, :, :-1]  # [M, mb, T]
+        targets = tokens[:, :, 1:]
+        m, mb, t = inputs.shape
+        cos, sin = rope_frequencies(cfg, jnp.arange(t))
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def stage_forward(stages_, x):
+            def one(h, layer):
+                h, _ = layer_apply(h, layer, cfg, cos, sin)
+                return h, None
+
+            h, _ = jax.lax.scan(one, x, stages_)
+            return h
+
+        def head_loss(head, y, target):
+            h = rms_norm(y, head["final_norm"], cfg.norm_eps)
+            logits = (h @ head["lm_head"]).astype(jnp.float32)
+            b_, t_, v_ = logits.shape
+            losses = fused_cross_entropy(
+                logits.reshape(b_ * t_, v_), target.reshape(-1)
+            )
+            return jnp.mean(losses)
+
+        head = {"final_norm": final_norm, "lm_head": lm_head}
+        act_shape = (mb, t, cfg.dim)
+        zero_act = jnp.zeros(act_shape, cfg.dtype)
+
+        def tick(carry, tau):
+            (fwd_in, bwd_in, ring, f_cnt, b_cnt, g_stages, g_embed, g_head,
+             loss_sum) = carry
+            do_f = jnp.logical_and(tau == stage + 2 * f_cnt, f_cnt < m)
+            do_b = jnp.logical_and(
+                tau == 2 * n_stages - 1 - stage + 2 * b_cnt, b_cnt < m
+            )
+
+            # ---- forward tick -------------------------------------------
+            def f_branch(args):
+                fwd_in, ring, f_cnt = args
+                mb_idx = jnp.clip(f_cnt, 0, m - 1)
+                x0 = embed[inputs[mb_idx]].astype(cfg.dtype)  # [mb, T, D]
+                x_in = jnp.where(is_first, x0, fwd_in)
+                y = stage_forward(stages, x_in)
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, x_in, jnp.mod(f_cnt, n_stages), axis=0
+                )
+                return y, ring, f_cnt + 1
+
+            def f_skip(args):
+                fwd_in, ring, f_cnt = args
+                return zero_act, ring, f_cnt
+
+            y_out, ring, f_cnt = jax.lax.cond(
+                do_f, f_branch, f_skip, (fwd_in, ring, f_cnt)
+            )
+
+            # ---- backward tick ------------------------------------------
+            def b_branch(args):
+                bwd_in, b_cnt, g_stages, g_embed, g_head, loss_sum = args
+                mb_idx = jnp.clip(b_cnt, 0, m - 1)
+                x_stored = ring[jnp.mod(b_cnt, n_stages)]
+                y_st, vjp_fn = jax.vjp(stage_forward, stages, x_stored)
+
+                # last stage: seed from head+loss (computed HERE — that is
+                # the 1F1B property); other stages: seed from the grad hop
+                def seed_last(_):
+                    (loss, (dhead, dy)) = jax.value_and_grad(
+                        head_loss, argnums=(0, 1)
+                    )(head, y_st, targets[mb_idx])
+                    return dy.astype(cfg.dtype), dhead, loss
+
+                def seed_mid(_):
+                    zero_head = jax.tree_util.tree_map(jnp.zeros_like, head)
+                    return bwd_in, zero_head, jnp.zeros((), jnp.float32)
+
+                dy, dhead, loss = jax.lax.cond(is_last, seed_last, seed_mid, None)
+                dstages, dx = vjp_fn(dy)
+                g_stages = jax.tree_util.tree_map(
+                    jnp.add, g_stages, dstages
+                )
+                g_head = jax.tree_util.tree_map(jnp.add, g_head, dhead)
+
+                # stage 0 owns the embedding backward (vjp of the gather)
+                def embed_grad(_):
+                    _, evjp = jax.vjp(
+                        lambda e: e[inputs[mb_idx]].astype(cfg.dtype), embed
+                    )
+                    return evjp(dx)[0]
+
+                g_embed = g_embed + jax.lax.cond(
+                    is_first, embed_grad, lambda _: jnp.zeros_like(g_embed), None
+                )
+                return bwd_in, b_cnt + 1, g_stages, g_embed, g_head, \
+                    loss_sum + loss, dx
+
+            def b_skip(args):
+                bwd_in, b_cnt, g_stages, g_embed, g_head, loss_sum = args
+                return bwd_in, b_cnt, g_stages, g_embed, g_head, loss_sum, \
+                    zero_act
+
+            bwd_in, b_cnt, g_stages, g_embed, g_head, loss_sum, dx_out = (
+                jax.lax.cond(
+                    do_b,
+                    b_branch,
+                    b_skip,
+                    (bwd_in, b_cnt, g_stages, g_embed, g_head, loss_sum),
+                )
+            )
+
+            # ---- hops (unconditional: collectives can't live in cond) ---
+            fwd_in = jax.lax.ppermute(y_out, axis, fwd_perm)
+            bwd_in = jax.lax.ppermute(dx_out, axis, bwd_perm)
+            return (
+                fwd_in, bwd_in, ring, f_cnt, b_cnt, g_stages, g_embed,
+                g_head, loss_sum,
+            ), None
+
+        ring0 = jnp.zeros((n_stages,) + act_shape, cfg.dtype)
+        g_stages0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), stages
+        )
+        g_head0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), head
+        )
+        carry0 = (
+            zero_act, zero_act, ring0, jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32), g_stages0,
+            jnp.zeros_like(embed, jnp.float32), g_head0,
+            jnp.zeros((), jnp.float32),
+        )
+        total_ticks = 2 * (m + n_stages - 1)
+        (carry, _) = jax.lax.scan(
+            tick, carry0, jnp.arange(total_ticks, dtype=jnp.int32)
+        )
+        (_, _, _, _, _, g_stages, g_embed, g_head, loss_sum) = carry
+
+        # loss lives on the last stage; embed grad on stage 0; head grads
+        # on the last stage — psum over pipe replicates totals everywhere
+        loss = jax.lax.psum(loss_sum, axis) / m_total
+        g_embed = jax.lax.psum(g_embed, axis) / m_total
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis) / m_total, g_head
+        )
+        g_stages = jax.tree_util.tree_map(lambda g: g / m_total, g_stages)
+        if data_axis is not None:
+            loss = jax.lax.pmean(loss, data_axis)
+            g_embed = jax.lax.pmean(g_embed, data_axis)
+            g_head = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_head
+            )
+            g_stages = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_stages
+            )
+        grads = {
+            "embed": g_embed,
+            "stages": jax.tree_util.tree_map(lambda g: g[None], g_stages),
+            "final_norm": g_head["final_norm"],
+            "lm_head": g_head["lm_head"],
+        }
+        return loss, grads
+
+    param_specs = {
+        "embed": P(),
+        "stages": P(axis),
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+    tok_spec = P(None, data_axis) if data_axis else P()
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, tok_spec),
+        out_specs=(P(), param_specs),
+        check_vma=False,
+    )
+
+
+def make_pipeline_lm_train_step(
+    mesh: Mesh,
+    cfg,
+    optimizer,
+    n_microbatches: int,
+    axis: str = "pipe",
+    data_axis: str = None,
+    donate: bool = True,
+):
+    """1F1B pipeline-parallel LM train step: ``step(state, tokens) ->
+    (state, loss)`` with state = {params (stage layout), opt_state, step}.
+    ``tokens`` [M, mb, T+1]. Loss and grads are mathematically identical
+    to the non-pipelined ``make_lm_train_step`` on the unstaged params
+    (equivalence is asserted in tests/test_parallel.py)."""
+    import optax
+    from jax.sharding import NamedSharding
+
+    loss_and_grads = pipeline_lm_loss_and_grads(
+        mesh, cfg, n_microbatches, axis=axis, data_axis=data_axis
+    )
+
+    def step_fn(state, tokens):
+        loss, grads = loss_and_grads(state["params"], tokens)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return {
+            **state,
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    param_specs = {
+        "embed": P(),
+        "stages": P(axis),
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+    state_sharding = {
+        "params": jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+        "opt_state": NamedSharding(mesh, P()),
+        "step": NamedSharding(mesh, P()),
+    }
+    tok_spec = NamedSharding(mesh, P(None, data_axis) if data_axis else P())
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_sharding, tok_spec),
+        out_shardings=(state_sharding, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -498,3 +498,88 @@ def test_multihost_initialize_env_wiring(monkeypatch):
     monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
     assert multihost_initialize() is False
     assert calls == {}
+
+
+def test_pipeline_1f1b_transformer_equivalence():
+    """VERDICT r1 next #4: the 1F1B pipeline through the REAL transformer
+    (embed -> stage-sharded layer groups -> head) must produce the SAME
+    loss and gradients as the non-pipelined forward+backward."""
+    import dataclasses
+
+    import optax
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.ops.losses import fused_cross_entropy
+    from devspace_tpu.parallel.mesh import create_mesh
+    from devspace_tpu.parallel.pipeline import (
+        make_pipeline_lm_train_step,
+        pipeline_lm_loss_and_grads,
+        transformer_stage_params,
+        transformer_unstage_params,
+    )
+
+    cfg = dataclasses.replace(tfm.TINY, dtype=jnp.float32, n_layers=4)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    S, M, mb, T = 4, 4, 2, 16
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (M, mb, T + 1), 0, cfg.vocab_size
+    )
+    flat = tokens.reshape(M * mb, T + 1)
+
+    def loss_fn(p):
+        logits = tfm.forward(p, flat[:, :-1], cfg)
+        b, t, v = logits.shape
+        return jnp.mean(
+            fused_cross_entropy(logits.reshape(b * t, v), flat[:, 1:].reshape(-1))
+        )
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params)
+
+    mesh = create_mesh({"pipe": S}, devices=jax.devices()[:S])
+    staged = transformer_stage_params(params, S)
+    loss, grads = jax.jit(pipeline_lm_loss_and_grads(mesh, cfg, M))(staged, tokens)
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+
+    unstaged = transformer_unstage_params(grads)
+    for (pa, ga), (pb, gb) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(unstaged)[0],
+    ):
+        assert pa == pb
+        denom = float(jnp.max(jnp.abs(ga))) + 1e-9
+        rel = float(jnp.max(jnp.abs(ga - gb))) / denom
+        assert rel < 1e-4, (pa, rel)
+
+    # the jitted train step runs and reduces the loss
+    opt = optax.sgd(0.01)
+    state = {
+        "params": staged,
+        "opt_state": opt.init(staged),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_pipeline_lm_train_step(mesh, cfg, opt, M)
+    state, l1 = step(state, tokens)
+    state, l2 = step(state, tokens)
+    assert float(l2) < float(l1)
+
+
+def test_pipeline_stage_params_roundtrip():
+    import dataclasses
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.parallel.pipeline import (
+        transformer_stage_params,
+        transformer_unstage_params,
+    )
+
+    cfg = dataclasses.replace(tfm.TINY, n_layers=4)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    back = transformer_unstage_params(transformer_stage_params(params, 2))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(back)[0],
+    ):
+        assert pa == pb and bool(jnp.all(a == b))
+
+    with pytest.raises(ValueError, match="not divisible"):
+        transformer_stage_params(params, 3)
