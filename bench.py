@@ -4,12 +4,19 @@ Headline metric (BASELINE.json): ResNet-50 training throughput in
 images/sec, measured on the available accelerator (one real TPU chip under
 the driver; per-chip numbers scale linearly across the slice via the
 data-parallel step, which is what the v5e-16 target multiplies out of).
-The reference publishes no numbers (BASELINE.md: ``published: {}``), so
-``vs_baseline`` is reported against the reference's only quantified
-characteristic we share: the dev-loop edit->remote latency budget
-(reference design >= ~1.0s upstream debounce; ours measured end-to-end on
-the fake slice) — values > 1 mean faster than the reference design.
-All diagnostics go to stderr; stdout carries exactly one JSON line.
+
+The reference publishes no benchmark numbers (BASELINE.md:
+``published: {}``), so ``vs_baseline`` compares against OUR round-1
+measurement of the same metric (2511.4 imgs/sec) — the only prior number
+this metric has. The reference's sole quantified shared characteristic
+(its >= ~1.0s dev-loop debounce latency floor) is reported under its own
+key ``sync_vs_reference_debounce``, NOT as the headline ratio.
+
+Extra keys in the same JSON object: achieved model TFLOP/s + MFU for the
+ResNet line, an LM (transformer + flash attention) training line, and the
+dev-loop latency numbers. Methodology notes and the roofline analysis
+live in docs/PERF.md. All diagnostics go to stderr; stdout carries
+exactly one JSON line.
 """
 
 from __future__ import annotations
@@ -60,21 +67,109 @@ def resnet_train_throughput(
         model.apply, optimizer, has_batch_stats=True, donate=True
     )
     batch_dict = {"image": images, "label": labels}
+    # device_get sync: block_until_ready can return early for some
+    # patterns on the tunneled device (docs/PERF.md methodology)
     t0 = time.time()
     for _ in range(warmup):
         state, loss = step(state, batch_dict)
-    jax.block_until_ready(loss)
+    warm_loss = float(jax.device_get(loss))
     if not quiet:
-        log(f"[bench] warmup+compile {time.time() - t0:.1f}s, loss={float(loss):.3f}")
+        log(f"[bench] warmup+compile {time.time() - t0:.1f}s, loss={warm_loss:.3f}")
     t0 = time.time()
     for _ in range(steps):
         state, loss = step(state, batch_dict)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     elapsed = time.time() - t0
     imgs_per_sec = batch * steps / elapsed
     if not quiet:
         log(f"[bench] {steps} steps in {elapsed:.2f}s -> {imgs_per_sec:.1f} imgs/sec")
     return imgs_per_sec
+
+
+# nominal bf16 peak TFLOP/s by TPU generation (public spec sheets);
+# docs/PERF.md records the DEMONSTRATED matmul ceiling on this tunneled
+# chip, which is far below nominal — MFU here is reported against nominal
+# so numbers are comparable to literature.
+NOMINAL_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,  # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6": 918.0,  # trillium
+}
+
+RESNET50_FWD_GFLOP_PER_IMG = 4.09  # v1.5 @224, multiply-add = 2 flops
+ROUND1_RESNET_IMGS_PER_SEC = 2511.4  # BENCH_r01.json
+
+
+def device_nominal_peak() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in NOMINAL_PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def bench_lm_train(
+    steps: int = 12, warmup: int = 3
+) -> tuple[float, float, str]:
+    """Transformer (llama-style, flash attention active at T=2048)
+    training throughput -> (tokens/sec, model TFLOP/s, platform). A
+    ~200M-param config that fills one chip; 6*N*tokens accounting."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.training.trainer import make_lm_train_step
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = tfm.TransformerConfig(
+            vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=16, ffn_dim=4096, max_seq_len=2048,
+        )
+        batch, seqlen = 8, 2048
+    else:  # CPU smoke numbers
+        cfg = tfm.TINY
+        batch, seqlen = 2, 64
+        steps, warmup = 3, 1
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    optimizer = optax.adamw(3e-4)
+    state = {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_lm_train_step(tfm.forward, cfg, optimizer)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seqlen + 1), 0, cfg.vocab_size
+    )
+    # sync via device_get of the loss VALUE: block_until_ready has been
+    # observed returning early for this pattern on the tunneled device
+    # (docs/PERF.md methodology) — fetching the scalar cannot lie.
+    t0 = time.time()
+    for _ in range(warmup):
+        state, loss = step(state, tokens)
+    float(jax.device_get(loss))
+    log(f"[bench] lm warmup+compile {time.time() - t0:.1f}s ({n_params/1e6:.0f}M params)")
+    t0 = time.time()
+    for _ in range(steps):
+        state, loss = step(state, tokens)
+    final_loss = float(jax.device_get(loss))
+    elapsed = time.time() - t0
+    log(f"[bench] lm final loss {final_loss:.4f}")
+    tok_s = batch * seqlen * steps / elapsed
+    tflops = 6 * n_params * tok_s / 1e12
+    log(
+        f"[bench] lm {steps} steps in {elapsed:.2f}s -> {tok_s:.0f} tok/s, "
+        f"{tflops:.1f} model TF/s"
+    )
+    return tok_s, tflops, platform
 
 
 def bench_resnet50() -> tuple[float, str]:
@@ -317,10 +412,42 @@ def run_resnet_isolated() -> tuple[float, str]:
     return result or (0.0, "none")
 
 
+def run_lm_isolated() -> tuple[float, float, str]:
+    """LM bench in a child process (same wedge-protection rationale as
+    run_resnet_isolated; TPU work must also never overlap the resnet
+    child — see docs/PERF.md on single-chip contention)."""
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--lm-child"],
+            capture_output=True,
+            text=True,
+            timeout=1200.0,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        log("[bench] lm child timed out")
+        return 0.0, 0.0, "none"
+    for line in out.stderr.splitlines():
+        log(line)
+    for line in out.stdout.splitlines():
+        if line.startswith("LM_RESULT "):
+            _, tok_s, tflops, platform = line.split()
+            return float(tok_s), float(tflops), platform
+    log(f"[bench] lm child failed (rc={out.returncode})")
+    return 0.0, 0.0, "none"
+
+
 def main() -> int:
     if "--resnet-child" in sys.argv:
         imgs_per_sec, platform = bench_resnet50()
         print(f"RESNET_RESULT {imgs_per_sec} {platform}", flush=True)
+        return 0
+    if "--lm-child" in sys.argv:
+        tok_s, tflops, platform = bench_lm_train()
+        print(f"LM_RESULT {tok_s} {tflops} {platform}", flush=True)
         return 0
     sync_latency = None
     try:
@@ -328,6 +455,7 @@ def main() -> int:
         log(f"[bench] sync edit->4-workers median latency {sync_latency * 1000:.0f}ms")
     except Exception as e:  # noqa: BLE001
         log(f"[bench] sync latency bench failed: {e}")
+    dev_s = None
     try:
         dev_s = bench_dev_loop()
         log(
@@ -341,22 +469,50 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"[bench] resnet bench failed: {e}")
         imgs_per_sec, platform = 0.0, "none"
-    # vs_baseline: reference design's dev-loop latency floor (~1.0s
-    # upstream debounce alone) over ours — >1 means we beat the reference.
+    lm_tok_s, lm_tflops, _lm_platform = 0.0, 0.0, "none"
+    try:
+        lm_tok_s, lm_tflops, _lm_platform = run_lm_isolated()
+    except Exception as e:  # noqa: BLE001
+        log(f"[bench] lm bench failed: {e}")
+    # MFU accounting (VERDICT r1 next #1): model-math TFLOP/s and the
+    # fraction of the chip's NOMINAL bf16 peak (197 TF/s for v5e). The
+    # demonstrated matmul ceiling of this tunneled chip is far lower —
+    # docs/PERF.md carries that roofline analysis.
+    resnet_tflops = imgs_per_sec * 3 * RESNET50_FWD_GFLOP_PER_IMG / 1e3
+    peak = None
+    try:
+        peak = device_nominal_peak()
+    except Exception:  # noqa: BLE001
+        peak = None
     REFERENCE_LATENCY_FLOOR_S = 1.0
-    vs_baseline = (
-        REFERENCE_LATENCY_FLOOR_S / sync_latency if sync_latency else 1.0
-    )
-    print(
-        json.dumps(
-            {
-                "metric": f"resnet50_train_imgs_per_sec ({platform}, 1 chip)",
-                "value": round(imgs_per_sec, 1),
-                "unit": "imgs/sec",
-                "vs_baseline": round(vs_baseline, 2),
-            }
+    result = {
+        "metric": f"resnet50_train_imgs_per_sec ({platform}, 1 chip)",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec",
+        # ratio vs OUR round-1 measurement of this same metric — the
+        # reference publishes no numbers (BASELINE.md published: {})
+        "vs_baseline": round(imgs_per_sec / ROUND1_RESNET_IMGS_PER_SEC, 3),
+        "baseline": f"round1 {ROUND1_RESNET_IMGS_PER_SEC} imgs/sec (reference publishes no benchmarks)",
+        "resnet_model_tflops": round(resnet_tflops, 1),
+        "resnet_mfu_nominal_pct": round(100 * resnet_tflops / peak, 1)
+        if peak
+        else None,
+        "lm_train_tokens_per_sec": round(lm_tok_s, 0),
+        "lm_model_tflops": round(lm_tflops, 1),
+        "lm_mfu_nominal_pct": round(100 * lm_tflops / peak, 1) if peak else None,
+        "sync_edit_to_slice_ms": round(sync_latency * 1000, 0)
+        if sync_latency
+        else None,
+        # the reference's only quantified shared characteristic: its >=1s
+        # upstream debounce latency floor, under its OWN key (VERDICT r1)
+        "sync_vs_reference_debounce": round(
+            REFERENCE_LATENCY_FLOOR_S / sync_latency, 2
         )
-    )
+        if sync_latency
+        else None,
+        "dev_loop_cold_s": round(dev_s, 2) if dev_s else None,
+    }
+    print(json.dumps(result))
     return 0
 
 

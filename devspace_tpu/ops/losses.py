@@ -43,12 +43,24 @@ def _xent_kernel(logits_ref, labels_ref, o_ref, lse_ref):
     lse_ref[:] = lse
 
 
+def _effective_block_rows(block_rows: int, b: int, v: int) -> int:
+    """Scale the row block so a [BR, V] f32 block (plus its exp/shift
+    intermediates, ~2 copies) stays well inside the ~16MB scoped VMEM
+    budget — a 32k vocab at BR=128 is 15.6MB per copy and OOMs Mosaic's
+    stack allocator (observed on v5e at [16384, 32000])."""
+    budget_rows = max(8, (4 * 1024 * 1024) // (v * 4))
+    br = 8
+    while br * 2 <= min(block_rows, budget_rows):
+        br *= 2
+    return min(br, b)
+
+
 def _xent_pallas_fwd(logits, labels, block_rows: int = 128):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, v = logits.shape
-    block_rows = min(block_rows, b)
+    block_rows = _effective_block_rows(block_rows, b, v)
     col = pl.BlockSpec((block_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
     loss, lse = pl.pallas_call(
         _xent_kernel,
@@ -74,8 +86,10 @@ def _xent(logits, labels, block_rows):
 
 
 def _xent_fwd(logits, labels, block_rows):
-    b, _ = logits.shape
-    if (use_pallas() or interpret_mode()) and b % min(block_rows, b) == 0:
+    b, v = logits.shape
+    if (use_pallas() or interpret_mode()) and b % _effective_block_rows(
+        block_rows, b, v
+    ) == 0:
         loss, lse = _xent_pallas_fwd(logits, labels, block_rows)
     else:
         f32 = logits.astype(jnp.float32)

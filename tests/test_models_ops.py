@@ -465,3 +465,15 @@ def test_forward_return_kv_matches_decode_cache():
 
     with pytest.raises(ValueError, match="remat"):
         tfm.forward(params, tokens, cfg, return_kv=True, remat=True)
+
+
+def test_xent_block_rows_scale_with_vocab():
+    """A 32k vocab must shrink the Pallas row block below the VMEM budget
+    (128-row blocks OOM Mosaic's stack allocator at [16384, 32000])."""
+    from devspace_tpu.ops.losses import _effective_block_rows
+
+    assert _effective_block_rows(128, 16384, 32000) * 32000 * 4 <= 4 << 20
+    assert _effective_block_rows(128, 16384, 256) == 128  # small vocab keeps 128
+    assert _effective_block_rows(128, 4, 256) == 4  # never exceeds batch
+    # divisibility contract: power-of-two blocks divide power-of-two batches
+    assert 16384 % _effective_block_rows(128, 16384, 32000) == 0
