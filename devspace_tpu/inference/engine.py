@@ -618,11 +618,13 @@ class InferenceEngine:
         if slot.remaining <= 0 or (
             req.eos_id is not None and token == req.eos_id
         ):
-            req.done.set()
             slot.req = None
             slot.ready = False
             self._free_slot_blocks(slot_idx)
             self.requests_completed += 1
+            # done LAST: result()/stats() callers wake on it and must see
+            # the counters and the freed blocks already settled
+            req.done.set()
 
     def _next_pending(self) -> Optional[Request]:
         if self._resume:

@@ -424,3 +424,36 @@ def test_full_window_request_with_coresident_long_decode(params):
         assert h_long.result(timeout=300) == reference_generate(params, [1, 2, 3], 50)
     finally:
         engine.stop()
+
+
+def test_engine_stress_mixed_workload(params):
+    """Soak the paged engine: more requests than slots, mixed prompt and
+    generation lengths, mixed sampling, a tight pool — every request
+    completes, greedy ones exactly, and the allocator balances."""
+    rng = np.random.default_rng(7)
+    engine = InferenceEngine(
+        params, CFG, max_slots=3, max_len=64,
+        block_size=8, n_blocks=20, prefill_chunk=8, chunk_max=4,
+    ).start()
+    try:
+        jobs = []
+        for i in range(12):
+            plen = int(rng.integers(1, 40))
+            n = int(rng.integers(1, min(10, 64 - plen)))
+            prompt = [int(x) for x in rng.integers(1, CFG.vocab_size, size=plen)]
+            temp = 0.0 if i % 2 == 0 else 0.7
+            jobs.append((prompt, n, temp, engine.submit(prompt, n, temperature=temp, seed=i)))
+        for prompt, n, temp, h in jobs:
+            got = h.result(timeout=600)
+            assert len(got) == n
+            if temp == 0.0:
+                assert got == reference_generate(params, prompt, n), (
+                    f"greedy divergence plen={len(prompt)} n={n}"
+                )
+            else:
+                assert all(0 <= t < CFG.vocab_size for t in got)
+        st = engine.stats()
+        assert st["requests_completed"] == 12 and st["requests_failed"] == 0
+        assert st["free_blocks"] == st["total_blocks"], "leaked blocks"
+    finally:
+        engine.stop()
