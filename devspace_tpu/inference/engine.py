@@ -463,14 +463,14 @@ class InferenceEngine:
             if req.done.is_set():
                 continue  # completed concurrently — don't double-count
             req.error = reason
-            req.done.set()
             self.requests_failed += 1
+            req.done.set()  # done LAST (see _emit)
         if not drain_queue:
             return
         for req in self._resume:
             req.error = reason
-            req.done.set()
             self.requests_failed += 1
+            req.done.set()  # done LAST (see _emit)
         self._resume.clear()
         while True:
             try:
@@ -478,8 +478,8 @@ class InferenceEngine:
             except queue.Empty:
                 break
             req.error = reason
-            req.done.set()
             self.requests_failed += 1
+            req.done.set()  # done LAST (see _emit)
 
     def _recover_pool_if_lost(self) -> None:
         """After a failed prefill/decode dispatch: the pool may have been
@@ -651,10 +651,10 @@ class InferenceEngine:
                         break
                 except Exception as e:  # noqa: BLE001 — surface per-request
                     req.error = str(e)
-                    req.done.set()
                     self.slots[i].req = None
                     self.requests_failed += 1
                     self._recover_pool_if_lost()
+                    req.done.set()  # done LAST (see _emit)
             prefilling = [
                 i
                 for i, s in enumerate(self.slots)
@@ -685,9 +685,10 @@ class InferenceEngine:
                     self._free_slot_blocks(i)
                     if req is not None:
                         req.error = str(e)
-                        req.done.set()
                         self.requests_failed += 1
                     self._recover_pool_if_lost()
+                    if req is not None:
+                        req.done.set()  # done LAST (see _emit)
                 if not ready:
                     continue  # nothing to decode yet — keep prefilling
             if not ready:

@@ -120,3 +120,55 @@ def fused_cross_entropy(logits, labels, block_rows: int = 128):
     if use_pallas() or interpret_mode():
         return _xent(logits, labels, block_rows)
     return cross_entropy_reference(logits, labels)
+
+
+def vocab_parallel_cross_entropy(mesh, axis: str = "model", batch_axis=None):
+    """Cross-entropy over VOCAB-SHARDED logits (the Megatron-LM trick):
+    with the LM head column-sharded over ``axis``, each device computes
+    its local max / sum-exp / picked-logit and three tiny collectives
+    (pmax + two psums) produce the exact loss — the full ``[B, V]``
+    logits tensor is never gathered, removing the largest single
+    allocation of an LM train step (docs/PERF.md: f32 [B, T, 32000] was
+    7.8GB at batch 32). Returns ``loss_fn(logits, labels) -> [B] f32``
+    to be called INSIDE jit over the same mesh: the shard_map forces the
+    logits to arrive vocab-sharded (GSPMD lays the preceding matmul out
+    accordingly) and hands back replicated per-example losses.
+    Differentiable — JAX transposes the collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[axis]
+
+    def local_fn(logits, labels):
+        # logits [B, V/S] this shard; labels [B] global vocab ids
+        logits = logits.astype(jnp.float32)
+        v_local = logits.shape[-1]
+        lo = jax.lax.axis_index(axis) * v_local
+        # pmax has no VJP, but the max shift cancels analytically in
+        # log(sum(exp(x - m))) + m, so zero gradient through it is exact
+        local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+        gmax = jax.lax.pmax(local_max, axis)
+        sumexp = jnp.sum(jnp.exp(logits - gmax[:, None]), axis=-1)
+        lse = jnp.log(jax.lax.psum(sumexp, axis)) + gmax
+        local_ids = labels - lo
+        in_shard = (local_ids >= 0) & (local_ids < v_local)
+        picked_here = jnp.take_along_axis(
+            logits, jnp.clip(local_ids, 0, v_local - 1)[:, None], axis=-1
+        )[:, 0]
+        picked = jax.lax.psum(jnp.where(in_shard, picked_here, 0.0), axis)
+        return lse - picked
+
+    def loss_fn(logits, labels):
+        b, v = logits.shape
+        if v % n_shards:
+            raise ValueError(f"vocab {v} not divisible by axis '{axis}' ({n_shards})")
+        return jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            # batch rides sharded over batch_axis (dp composition);
+            # vocab over `axis`; output replicated over `axis` only
+            in_specs=(P(batch_axis, axis), P(batch_axis)),
+            out_specs=P(batch_axis),
+            check_vma=False,
+        )(logits, labels)
+
+    return loss_fn

@@ -118,17 +118,36 @@ def make_lm_train_step(
     param_spec=None,
     attention_fn=None,
     donate: bool = True,
+    vocab_parallel_axis: Optional[str] = None,
 ):
     """Causal-LM train step for the transformer: next-token prediction with
     the fused cross-entropy. ``param_spec`` is a PartitionSpec tree for
-    tensor-parallel sharding (models.transformer.param_partition_spec)."""
+    tensor-parallel sharding (models.transformer.param_partition_spec).
+
+    ``vocab_parallel_axis`` (requires ``mesh``): compute the loss with
+    the Megatron vocab-parallel cross-entropy — the lm_head is
+    column-sharded over that axis and the full [B*T, vocab] logits are
+    never gathered (ops/losses.py:vocab_parallel_cross_entropy), removing
+    the train step's largest allocation."""
+    vp_loss = None
+    if vocab_parallel_axis is not None:
+        if mesh is None:
+            raise ValueError("vocab_parallel_axis needs a mesh")
+        from ..ops.losses import vocab_parallel_cross_entropy
+
+        vp_loss = vocab_parallel_cross_entropy(
+            mesh, axis=vocab_parallel_axis, batch_axis=data_axis
+        )
 
     def loss_fn(params, tokens):
         logits = forward(params, tokens[:, :-1], cfg, attention_fn=attention_fn)
         b, t, v = logits.shape
-        losses = fused_cross_entropy(
-            logits.reshape(b * t, v), tokens[:, 1:].reshape(-1)
-        )
+        if vp_loss is not None:
+            losses = vp_loss(logits.reshape(b * t, v), tokens[:, 1:].reshape(-1))
+        else:
+            losses = fused_cross_entropy(
+                logits.reshape(b * t, v), tokens[:, 1:].reshape(-1)
+            )
         return jnp.mean(losses)
 
     def step_fn(state, tokens):
