@@ -1009,6 +1009,37 @@ def cmd_install(args) -> int:
 
 def cmd_print_config(args) -> int:
     ctx = Context(args)
+    if getattr(args, "manifests", False):
+        # `helm template` equivalent: render every deployment's manifests
+        # without touching the cluster. Charts go through the SAME
+        # ChartDeployer.render_manifests the deploy path uses (identical
+        # context, paths resolved against the project root), with the
+        # last-built image tags from the generated cache when available.
+        from ..deploy.chart import ChartDeployer, ChartError
+        from ..deploy.manifests import create_deployer
+
+        cache = ctx.loader.generated.get_active().deploy
+        image_tags = dict(cache.image_tags or {})
+        for k, v in (ctx.config.images or {}).items():
+            if v.image:
+                image_tags.setdefault(k, f"{v.image}:dev")
+        docs: list[dict] = []
+        for d in ctx.config.deployments or []:
+            deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
+            try:
+                if isinstance(deployer, ChartDeployer):
+                    docs.extend(
+                        deployer.render_manifests(
+                            image_tags=image_tags, tpu=ctx.config.tpu
+                        )
+                    )
+                else:
+                    docs.extend(deployer.render_manifests(image_tags=image_tags))
+            except ChartError as e:
+                ctx.log.error("[print] %s: %s", d.name, e)
+                return 1
+        print(yaml.safe_dump_all(docs, sort_keys=False), end="")
+        return 0
     print(yaml.safe_dump(to_dict(ctx.config), sort_keys=False))
     return 0
 
@@ -1210,6 +1241,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_install)
 
     sp = sub.add_parser("print", help="print the resolved config")
+    sp.add_argument(
+        "--manifests",
+        action="store_true",
+        help="render every deployment's manifests without applying "
+        "(helm template equivalent)",
+    )
     sp.set_defaults(fn=cmd_print_config)
 
     return p

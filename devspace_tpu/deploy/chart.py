@@ -397,6 +397,7 @@ class ChartDeployer:
         deployment: latest.DeploymentConfig,
         namespace: str,
         logger: Optional[logutil.Logger] = None,
+        base_dir: str = ".",
     ):
         if deployment.chart is None or not deployment.name:
             raise ChartError("chart deployment needs a name and chart config")
@@ -404,12 +405,26 @@ class ChartDeployer:
         self.deployment = deployment
         self.namespace = deployment.namespace or namespace
         self.log = logger or logutil.get_logger()
+        # chart paths resolve against the PROJECT root, not the cwd —
+        # commands run from a subdirectory must see the same chart
+        self.base_dir = base_dir
+
+    def _resolve(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.base_dir, path)
+
+    @property
+    def chart_path(self) -> str:
+        return self._resolve(self.deployment.chart.path or "")
+
+    @property
+    def value_files(self) -> list[str]:
+        return [self._resolve(vf) for vf in self.deployment.chart.value_files or []]
 
     # -- cache key (reference: deploy/helm/deploy.go:29-80 skip-if-unchanged)
     def chart_hash(self) -> str:
-        path = self.deployment.chart.path
+        path = self.chart_path
         parts = [directory_hash(path)] if path and os.path.isdir(path) else []
-        for vf in self.deployment.chart.value_files or []:
+        for vf in self.value_files:
             try:
                 parts.append(str(os.path.getmtime(vf)))
             except OSError:
@@ -445,32 +460,8 @@ class ChartDeployer:
             if cache.chart_hashes.get(name) == new_hash:
                 self.log.info("[deploy] %s unchanged, skipping", name)
                 return False
-        workers = (tpu.workers if tpu else None) or 1
-        # Worker discovery wiring for multi-host slices: hostnames resolve
-        # through the chart's headless service (<release>-<i>.<release>);
-        # worker 0 is the JAX coordinator (north star: TPU_WORKER_ID /
-        # TPU_WORKER_HOSTNAMES across the slice).
-        hostnames = ",".join(f"{name}-{i}.{name}" for i in range(workers))
-        tpu_ctx = {
-            "accelerator": (tpu.accelerator if tpu else None) or "",
-            "topology": (tpu.topology if tpu else None) or "",
-            "workers": workers,
-            "chipsPerWorker": (tpu.chips_per_worker if tpu else None) or 1,
-            "runtimeVersion": (tpu.runtime_version if tpu else None) or "",
-            "workerHostnames": hostnames,
-            "coordinatorAddress": f"{name}-0.{name}:8476",
-        }
-        manifests = render_chart(
-            self.deployment.chart.path,
-            release_name=name,
-            namespace=self.namespace,
-            values=self.deployment.chart.values,
-            value_files=self.deployment.chart.value_files,
-            extra_context={
-                "images": image_tags or {},
-                "tpu": tpu_ctx,
-                "pullSecrets": pull_secrets or [],
-            },
+        manifests = self.render_manifests(
+            image_tags=image_tags, tpu=tpu, pull_secrets=pull_secrets
         )
         self.backend.ensure_namespace(self.namespace)
         for manifest in manifests:
@@ -487,6 +478,45 @@ class ChartDeployer:
             self.namespace,
         )
         return True
+
+    def render_manifests(
+        self,
+        image_tags: Optional[dict[str, str]] = None,
+        tpu: Optional[latest.TPUConfig] = None,
+        pull_secrets: Optional[list[str]] = None,
+    ) -> list[dict]:
+        """Render this deployment's manifests without applying anything —
+        the single source of the render context, shared by deploy() and
+        `print --manifests` (the helm-template equivalent).
+
+        Worker discovery wiring for multi-host slices: hostnames resolve
+        through the chart's headless service (<release>-<i>.<release>);
+        worker 0 is the JAX coordinator (north star: TPU_WORKER_ID /
+        TPU_WORKER_HOSTNAMES across the slice)."""
+        name = self.deployment.name
+        workers = (tpu.workers if tpu else None) or 1
+        hostnames = ",".join(f"{name}-{i}.{name}" for i in range(workers))
+        tpu_ctx = {
+            "accelerator": (tpu.accelerator if tpu else None) or "",
+            "topology": (tpu.topology if tpu else None) or "",
+            "workers": workers,
+            "chipsPerWorker": (tpu.chips_per_worker if tpu else None) or 1,
+            "runtimeVersion": (tpu.runtime_version if tpu else None) or "",
+            "workerHostnames": hostnames,
+            "coordinatorAddress": f"{name}-0.{name}:8476",
+        }
+        return render_chart(
+            self.chart_path,
+            release_name=name,
+            namespace=self.namespace,
+            values=self.deployment.chart.values,
+            value_files=self.value_files,
+            extra_context={
+                "images": image_tags or {},
+                "tpu": tpu_ctx,
+                "pullSecrets": pull_secrets or [],
+            },
+        )
 
     def _wait_ready(self, manifests: list[dict], timeout: float) -> None:
         """Wait for the release's workloads to finish rolling out —

@@ -129,13 +129,27 @@ _TOKEN_RE = re.compile(
 
 def _expr_tokens(s: str) -> list[str]:
     toks, pos = [], 0
+    prev_end = -1
     while pos < len(s):
         m = _TOKEN_RE.match(s, pos)
         if not m:
             if s[pos:].strip() == "":
                 break
             raise TemplateError(f"bad token in action: {s[pos:]!r}")
-        toks.append(m.group(1))
+        tok = m.group(1)
+        # Disambiguate `(expr).field` from `(expr) .field`: a field path
+        # with NO whitespace after the closing paren is an access on the
+        # paren result; with whitespace it is the next argument. Mark the
+        # attached case (\x01 prefix) since whitespace is otherwise lost.
+        if (
+            tok.startswith(".")
+            and toks
+            and toks[-1] == ")"
+            and m.start(1) == prev_end
+        ):
+            tok = "\x01" + tok
+        toks.append(tok)
+        prev_end = m.end()
         pos = m.end()
     return toks
 
@@ -366,6 +380,15 @@ def _build_functions(renderer: "Renderer") -> dict[str, Callable]:
         "set": lambda d, k, v: (d.__setitem__(k, v), d)[1],
         "unset": lambda d, k: (d.pop(k, None), d)[1],
         "hasKey": lambda d, k: k in (d or {}),
+        "omit": lambda d, *ks: {k: v for k, v in (d or {}).items() if k not in ks},
+        "pick": lambda d, *ks: {k: v for k, v in (d or {}).items() if k in ks},
+        "dig": _dig,
+        # sprig type predicates (bitnami common.tplvalues.render et al.)
+        "typeIs": lambda t, v: _type_matches(t, _go_type(v)),
+        "typeIsLike": lambda t, v: _type_matches(t, _go_type(v)),
+        "typeOf": _go_type,
+        "kindIs": lambda t, v: _type_matches(t, _go_kind(v)),
+        "kindOf": _go_kind,
         "keys": lambda *ds: [k for d in ds for k in (d or {})],
         "values": lambda d: list((d or {}).values()),
         "pluck": lambda k, *ds: [d[k] for d in ds if k in (d or {})],
@@ -488,6 +511,60 @@ def _merge_dicts(dest: dict, srcs, overwrite: bool) -> dict:
             elif overwrite or k not in dest:
                 dest[k] = v
     return dest
+
+
+def _dig(*args):
+    """sprig dig: path segments..., default, dict — nil-safe nested get."""
+    if len(args) < 3:
+        raise TemplateError("dig needs at least: key, default, dict")
+    *path, default, d = args
+    cur = d
+    for part in path:
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def _go_kind(v: Any) -> str:
+    if v is None:
+        return "invalid"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int64"
+    if isinstance(v, float):
+        return "float64"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, dict):
+        return "map"
+    if isinstance(v, (list, tuple)):
+        return "slice"
+    return type(v).__name__
+
+
+_NUMERIC_TYPE_NAMES = {"int", "int64", "float64"}
+
+
+def _type_matches(asked: str, actual: str) -> bool:
+    """Helm's YAML->JSON pipeline turns every .Values number into
+    float64, while numbers from template functions are int64 — charts
+    guard against either. PyYAML preserves int/float, so treating the
+    numeric type names as one family makes both guard styles behave as
+    they do under real helm."""
+    if asked in _NUMERIC_TYPE_NAMES and actual in _NUMERIC_TYPE_NAMES:
+        return True
+    return asked == actual
+
+
+def _go_type(v: Any) -> str:
+    kind = _go_kind(v)
+    if kind == "map":
+        return "map[string]interface {}"
+    if kind == "slice":
+        return "[]interface {}"
+    return kind
 
 
 def _seq(*a):
@@ -727,9 +804,11 @@ class Renderer:
                 j += 1
             inner = toks[pos + 1 : j - 1]
             val = self._eval_pipeline(inner, dot, scopes)
-            # field access on a parenthesized expr: (dict "k" "v").k
-            if j < len(toks) and toks[j].startswith(".") and len(toks[j]) > 1:
-                val = _field(val, toks[j][1:])
+            # field access on a parenthesized expr: (dict "k" "v").k —
+            # only when the field was ADJACENT to the paren (\x01 mark);
+            # `tpl (...) .context` keeps .context as the next argument
+            if j < len(toks) and toks[j].startswith("\x01"):
+                val = _field(val, toks[j][2:])
                 j += 1
             return val, j
         if t.startswith('"') or t.startswith("`"):

@@ -82,17 +82,14 @@ class ManifestDeployer:
                             docs.append(doc)
         return docs
 
-    def deploy(
-        self,
-        image_tags: Optional[dict[str, str]] = None,
-        force: bool = False,
-        cache=None,
-        **_: object,
-    ) -> bool:
+    def render_manifests(
+        self, image_tags: Optional[dict[str, str]] = None, **_: object
+    ) -> list[dict]:
+        """Load + image-rewrite without applying (shared by deploy() and
+        `print --manifests`). build_all returns {config_name:
+        "repo:tag"}; manifests reference images by repo, so the rewrite
+        map is keyed by repo too."""
         docs = self._load()
-        self.backend.ensure_namespace(self.namespace)
-        # build_all returns {config_name: "repo:tag"}; manifests reference
-        # images by repo, so key the rewrite map by repo too.
         repo_map: dict[str, str] = {}
         for key, ref in (image_tags or {}).items():
             repo_map[ref.rsplit(":", 1)[0]] = ref
@@ -102,6 +99,18 @@ class ManifestDeployer:
             if repo_map:
                 rewrite_image_tags(doc, repo_map)
             doc.setdefault("metadata", {}).setdefault("namespace", self.namespace)
+        return docs
+
+    def deploy(
+        self,
+        image_tags: Optional[dict[str, str]] = None,
+        force: bool = False,
+        cache=None,
+        **_: object,
+    ) -> bool:
+        docs = self.render_manifests(image_tags=image_tags)
+        self.backend.ensure_namespace(self.namespace)
+        for doc in docs:
             self.backend.apply(doc, namespace=self.namespace)
         self.log.done(
             "[deploy] %s: applied %d manifest(s)", self.deployment.name, len(docs)
@@ -139,7 +148,7 @@ def create_deployer(backend, deployment: latest.DeploymentConfig, namespace: str
     from .chart import ChartDeployer
 
     if deployment.chart is not None:
-        return ChartDeployer(backend, deployment, namespace, logger)
+        return ChartDeployer(backend, deployment, namespace, logger, base_dir=base_dir)
     if deployment.manifests is not None:
         return ManifestDeployer(backend, deployment, namespace, base_dir, logger)
     raise ValueError(f"deployment {deployment.name} has neither chart nor manifests")

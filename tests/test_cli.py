@@ -228,3 +228,29 @@ def test_upgrade_degrades_gracefully_outside_git(tmp_path, monkeypatch):
     monkeypatch.setattr(cli_main_mod, "_checkout_root", lambda: str(tmp_path))
     rc = cli_main_mod.main(["upgrade", "--apply"])
     assert rc == 1  # failed, but gracefully (warn path, no exception)
+
+
+def test_print_manifests_renders_without_applying(tmp_path, monkeypatch, capsys):
+    """`print --manifests` is the helm-template equivalent: full render
+    of every deployment, nothing applied."""
+    import yaml as _yaml
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    (proj / "train.py").write_text("print('x')\n")
+    assert main(["init"]) == 0
+    capsys.readouterr()
+    assert main(["print", "--manifests"]) == 0
+    out = capsys.readouterr().out
+    docs = [d for d in _yaml.safe_load_all(out) if d]
+    kinds = {d["kind"] for d in docs}
+    assert "Deployment" in kinds or "StatefulSet" in kinds
+    assert "Service" in kinds
+    # nothing was applied to the cluster
+    import json, os
+    state = json.load(open(tmp_path / "cluster" / "cluster-state.json")) if (
+        tmp_path / "cluster" / "cluster-state.json").exists() else {"objects": []}
+    assert not state.get("objects")

@@ -11,6 +11,7 @@ from devspace_tpu.config.generated import CacheConfig
 from devspace_tpu.deploy.chart import ChartDeployer, ChartError, render_chart
 from devspace_tpu.deploy.manifests import (
     ManifestDeployer,
+    create_deployer,
     deploy_all,
     purge_all,
     rewrite_image_tags,
@@ -524,3 +525,28 @@ def test_release_revision_and_rollout_status(tmp_path):
                       "metadata": {"name": workload["name"], "namespace": "default"}})
     st = {s["name"]: s for s in dep.status()}
     assert st[workload["name"]]["rollout"] == "Missing"
+
+
+def test_chart_deploy_resolves_paths_against_base_dir(tmp_path):
+    """Chart paths resolve against the PROJECT root, not the cwd —
+    deploying from a subdirectory must find the same chart (base_dir
+    plumbing through create_deployer)."""
+    proj = tmp_path / "proj"
+    chart = proj / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: app\nversion: 1.0.0\n")
+    (chart / "templates" / "cm.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: app-cm\n"
+    )
+    fc = FakeCluster(str(tmp_path / "cluster"))
+    d = latest.DeploymentConfig(name="app", chart=latest.ChartConfig(path="./chart"))
+    cwd = os.getcwd()
+    sub = proj / "deep" / "inside"
+    sub.mkdir(parents=True)
+    try:
+        os.chdir(sub)  # simulate running from a subdirectory
+        dep = create_deployer(fc, d, "default", str(proj))
+        assert dep.deploy(wait=False) is True
+    finally:
+        os.chdir(cwd)
+    assert fc.get_object("v1", "ConfigMap", "app-cm", "default") is not None

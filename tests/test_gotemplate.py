@@ -548,3 +548,49 @@ def test_helm_render_error_has_template_name(tmp_path):
     (chart / "templates" / "x.yaml").write_text("{{ include \"missing\" . }}\n")
     with pytest.raises(ChartError, match="x.yaml"):
         render_chart(str(chart), "r", "default")
+
+
+def test_bitnami_style_tplvalues_render():
+    """The bitnami common-library idiom: typeIs + tpl to render values
+    that may themselves contain template syntax, plus omit/pick/dig."""
+    helpers = (
+        '{{- define "common.tplvalues.render" -}}'
+        '{{- if typeIs "string" .value }}{{- tpl .value .context }}'
+        '{{- else }}{{- tpl (.value | toYaml) .context }}{{- end }}'
+        '{{- end -}}'
+    )
+    ctx = {
+        "Values": {
+            "podLabels": {"tier": "{{ .Values.tierName }}"},
+            "tierName": "backend",
+            "extra": {"a": 1, "b": 2, "c": 3},
+        },
+        "Release": {"Name": "r"},
+    }
+    src = (
+        'labels:\n'
+        '{{- include "common.tplvalues.render" (dict "value" .Values.podLabels "context" $) | nindent 2 }}'
+    )
+    out = render(src, ctx, helpers=helpers)
+    assert yaml.safe_load(out) == {"labels": {"tier": "backend"}}
+    # string values render through tpl directly
+    src2 = '{{ include "common.tplvalues.render" (dict "value" "{{ .Release.Name }}-x" "context" $) }}'
+    assert render(src2, ctx, helpers=helpers) == "r-x"
+    # omit / pick / dig
+    assert render('{{ omit .Values.extra "b" | toJson }}', ctx) == '{"a": 1, "c": 3}'
+    assert render('{{ pick .Values.extra "b" | toJson }}', ctx) == '{"b": 2}'
+    assert render('{{ dig "x" "y" "fallback" .Values.extra }}', ctx) == "fallback"
+    assert render('{{ dig "a" 0 .Values.extra }}', ctx) == "1"
+    assert render('{{ kindOf .Values.extra }}/{{ kindOf .Values.tierName }}', ctx) == "map/string"
+
+
+def test_numeric_type_predicates_match_helm():
+    """Helm's YAML->JSON pipeline makes .Values numbers float64; PyYAML
+    keeps ints. Numeric type names are one family so charts written
+    against either behavior take the right branch."""
+    ctx = {"Values": {"port": 8080, "ratio": 0.5, "name": "x"}}
+    assert render('{{ typeIs "float64" .Values.port }}', ctx) == "true"
+    assert render('{{ typeIs "int64" .Values.port }}', ctx) == "true"
+    assert render('{{ kindIs "float64" .Values.ratio }}', ctx) == "true"
+    assert render('{{ typeIs "string" .Values.port }}', ctx) == "false"
+    assert render('{{ typeIs "float64" .Values.name }}', ctx) == "false"
