@@ -109,6 +109,7 @@ def analyze_tpu_slice(
     if not config.tpu or not config.deployments:
         return problems
     want = config.tpu.workers or 1
+    matched_any = False
     for d in config.deployments:
         if not d.name:
             continue
@@ -117,6 +118,18 @@ def analyze_tpu_slice(
         )
         if not pods:
             continue
+        # The slice checks apply to the TPU deployment only — auxiliary
+        # deployments (a vendored DB, a sidecar service) must not be
+        # measured against the slice topology. A deployment is the slice
+        # when its pods carry EXPLICIT TPU env wiring (tpu_worker_id's
+        # pod-name-ordinal fallback would match any StatefulSet).
+        if not any(
+            "TPU_WORKER_ID" in p.container_env()
+            or "TPU_WORKER_HOSTNAMES" in p.container_env()
+            for p in pods
+        ):
+            continue
+        matched_any = True
         running = [p for p in pods if get_pod_status(p) == "Running"]
         if len(running) != want:
             problems.append(
@@ -180,6 +193,12 @@ def analyze_tpu_slice(
                     f"{len(expected)}) — redeploy to rewire the slice"
                 )
                 break  # one report per slice is enough
+    if not matched_any and want > 1:
+        problems.append(
+            f"TPU config requests {want} workers but no deployment's pods "
+            "carry TPU_WORKER_ID/TPU_WORKER_HOSTNAMES — the slice chart "
+            "is not deployed (or its env wiring is missing)"
+        )
     return problems
 
 

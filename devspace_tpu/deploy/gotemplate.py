@@ -375,7 +375,11 @@ def _build_functions(renderer: "Renderer") -> dict[str, Callable]:
         "len": lambda v: len(v) if v is not None else 0,
         "index": _index,
         "list": lambda *a: list(a),
-        "dict": lambda *a: {a[i]: a[i + 1] for i in range(0, len(a) - 1, 2)},
+        # sprig pads an odd trailing key with "" rather than dropping it
+        "dict": lambda *a: {
+            a[i]: (a[i + 1] if i + 1 < len(a) else "")
+            for i in range(0, len(a), 2)
+        },
         "get": lambda d, k: (d or {}).get(k, ""),
         "set": lambda d, k, v: (d.__setitem__(k, v), d)[1],
         "unset": lambda d, k: (d.pop(k, None), d)[1],

@@ -495,10 +495,15 @@ class InferenceEngine:
             self._fail_outstanding(
                 "kv pool lost in failed dispatch", drain_queue=False
             )
-            self.pool = self._fresh_pool()
-            self._free_blocks = list(range(1, self.n_blocks))
-            self._tables[:] = 0
-            self._nalloc = [0] * self.max_slots
+            self._reset_pool()
+
+    def _reset_pool(self) -> None:
+        """Fresh pool + allocator state (all failure paths share this —
+        the invariant must not fork)."""
+        self.pool = self._fresh_pool()
+        self._free_blocks = list(range(1, self.n_blocks))
+        self._tables[:] = 0
+        self._nalloc = [0] * self.max_slots
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -571,6 +576,10 @@ class InferenceEngine:
         if slot.prefill_pos >= t:
             # prefill complete: first token from the last REAL position
             key = jax.random.PRNGKey(req.seed)
+            if req.tokens:
+                # preemption resume: don't replay the key sequence the
+                # pre-preemption prefix already consumed
+                key = jax.random.fold_in(key, len(req.tokens))
             key, sub = jax.random.split(key)
             self._keys = self._keys.at[slot_idx].set(key)
             first = sample_logits(
@@ -601,6 +610,8 @@ class InferenceEngine:
     def _preempt(self, i: int) -> None:
         slot = self.slots[i]
         req = slot.req
+        if req is None:
+            return
         slot.req = None
         slot.ready = False
         self._free_slot_blocks(i)
@@ -700,6 +711,11 @@ class InferenceEngine:
             k_steps = self._pick_chunk(max(1, min(want, room + 1)))
             for i in list(ready):
                 s = self.slots[i]
+                if s.req is None or not s.ready:
+                    # preempted as a victim while an earlier slot in this
+                    # pass grew its table — it no longer participates
+                    ready.remove(i)
+                    continue
                 # writes never pass max_len-1 (the decode scan clamps its
                 # positions), so coverage past max_len is never needed —
                 # and would index past the table row

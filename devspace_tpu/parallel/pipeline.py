@@ -438,7 +438,11 @@ def make_pipeline_lm_train_step(
             param_specs,
             is_leaf=lambda x: isinstance(x, P),
         ),
-        "opt_state": NamedSharding(mesh, P()),
+        # opt_state mirrors the params (Adam/SGD moments): leave it
+        # UNCONSTRAINED so GSPMD propagates the stage sharding into the
+        # moments — pinning it to P() would replicate ~2x the full model
+        # per device, forfeiting the pipeline's HBM scaling
+        "opt_state": None,
         "step": NamedSharding(mesh, P()),
     }
     tok_spec = NamedSharding(mesh, P(None, data_axis) if data_axis else P())

@@ -47,11 +47,15 @@ def test_analyze_events_groups_abnormal(tmp_path):
 
 def test_analyze_tpu_slice_checks(tmp_path):
     fc = FakeCluster(str(tmp_path))
-    # only 1 of 2 workers, and it has no TPU_WORKER_ID
-    fc.add_pod("app-0", labels={"app": "app"})
+    # only 1 of 2 workers running; a second pod lost its TPU_WORKER_ID
+    fc.add_pod("app-0", labels={"app": "app"}, worker_id=0)
     problems = analyze_tpu_slice(fc, _config(workers=2), "default")
     text = "\n".join(problems)
     assert "1/2 workers Running" in text
+    # id-less pod whose NAME has no ordinal either (the name-suffix
+    # fallback would otherwise supply the id)
+    fc.add_pod("app-extra", labels={"app": "app"})
+    text = "\n".join(analyze_tpu_slice(fc, _config(workers=2), "default"))
     assert "missing TPU_WORKER_ID" in text
 
     # healthy slice: both workers with distinct ids -> no problems
@@ -150,3 +154,25 @@ def test_analyze_tpu_stale_worker_hostnames(tmp_path):
     fc2 = _slice_cluster(tmp_path / "b", workers=2)
     probs = analyze_tpu_slice(fc2, _slice_config(2), "default")
     assert not any("stale" in p for p in probs)
+
+
+def test_analyze_tpu_checks_skip_auxiliary_deployments(tmp_path):
+    """Slice checks apply to the TPU deployment only: a vendored DB /
+    sidecar without TPU env wiring must not be measured against the
+    topology (no false 'headless service missing' noise)."""
+    fc = _slice_cluster(tmp_path, workers=2)
+    cfg = _slice_config(2, topology="2x4", chips=4)
+    cfg.deployments.append(latest.DeploymentConfig(name="cache"))
+    fc.add_pod("cache-0", labels={"app": "cache"})  # no TPU env
+    probs = analyze_tpu_slice(fc, cfg, "default")
+    assert not any("cache" in p for p in probs), probs
+
+
+def test_analyze_reports_missing_slice_wiring(tmp_path):
+    """Multi-worker TPU config whose pods carry no TPU env at all: one
+    clear report instead of per-deployment noise."""
+    fc = FakeCluster(str(tmp_path))
+    fc.add_pod("app-0", labels={"app": "app"})
+    fc.add_pod("app-1", labels={"app": "app"})
+    probs = analyze_tpu_slice(fc, _config(workers=2), "default")
+    assert len(probs) == 1 and "no deployment's pods carry" in probs[0]

@@ -457,3 +457,26 @@ def test_engine_stress_mixed_workload(params):
         assert st["free_blocks"] == st["total_blocks"], "leaked blocks"
     finally:
         engine.stop()
+
+
+def test_cascading_preemption_under_extreme_contention(params):
+    """Three concurrent requests on a pool that holds barely more than
+    one sequence: preemptions cascade, and a slot preempted as a victim
+    mid-pass must not be treated as live by the block-growth loop
+    (ghost-slot regression — it stranded blocks on empty slots, double
+    counted preemptions and could requeue None)."""
+    ps = [[2, 3, 4], [9, 8, 7], [5, 5, 5, 5]]
+    engine = InferenceEngine(
+        params, CFG, max_slots=3, max_len=64,
+        block_size=8, n_blocks=11, prefill_chunk=8, chunk_max=4,
+    ).start()
+    try:
+        handles = [engine.submit(p, 40) for p in ps]
+        for p, h in zip(ps, handles):
+            assert h.result(timeout=600) == reference_generate(params, p, 40)
+        st = engine.stats()
+        assert st["requests_completed"] == 3 and st["requests_failed"] == 0
+        assert st["free_blocks"] == st["total_blocks"], "stranded blocks"
+        assert None not in engine._resume
+    finally:
+        engine.stop()
