@@ -121,13 +121,10 @@ def analyze_tpu_slice(
         # The slice checks apply to the TPU deployment only — auxiliary
         # deployments (a vendored DB, a sidecar service) must not be
         # measured against the slice topology. A deployment is the slice
-        # when its pods carry EXPLICIT TPU env wiring (tpu_worker_id's
-        # pod-name-ordinal fallback would match any StatefulSet).
-        if not any(
-            "TPU_WORKER_ID" in p.container_env()
-            or "TPU_WORKER_HOSTNAMES" in p.container_env()
-            for p in pods
-        ):
+        # when its pods carry explicit wiring: TPU env in any container,
+        # or the GKE index annotations (NOT the pod-name-ordinal fallback,
+        # which would match any StatefulSet).
+        if not any(p.has_explicit_worker_identity for p in pods):
             continue
         matched_any = True
         running = [p for p in pods if get_pod_status(p) == "Running"]

@@ -805,7 +805,4 @@ class InferenceEngine:
                 # every caller, then rebuild a clean pool and keep
                 # serving new requests.
                 self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
-                self.pool = self._fresh_pool()
-                self._free_blocks = list(range(1, self.n_blocks))
-                self._tables[:] = 0
-                self._nalloc = [0] * self.max_slots
+                self._reset_pool()  # donated buffer is gone

@@ -77,6 +77,25 @@ class Pod:
         return {}
 
     @property
+    def has_explicit_worker_identity(self) -> bool:
+        """True when this pod carries TPU slice wiring by env (any
+        container) or index annotation — NOT the pod-name-ordinal
+        fallback, which would match any StatefulSet pod. Used to decide
+        whether a deployment IS the slice (analyze preflights)."""
+        for c in self.raw.get("spec", {}).get("containers") or []:
+            for e in c.get("env") or []:
+                if e.get("name") in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"):
+                    return True
+        ann = self.raw.get("metadata", {}).get("annotations") or {}
+        return any(
+            key in ann
+            for key in (
+                "batch.kubernetes.io/job-completion-index",
+                "apps.kubernetes.io/pod-index",
+            )
+        )
+
+    @property
     def tpu_worker_id(self) -> Optional[int]:
         """Worker index within a multi-host TPU slice. Sources, in order:
         the TPU_WORKER_ID env var (our charts wire it), the GKE-injected

@@ -432,23 +432,39 @@ def make_pipeline_lm_train_step(
         "final_norm": P(),
         "lm_head": P(),
     }
-    state_sharding = {
-        "params": jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s),
-            param_specs,
-            is_leaf=lambda x: isinstance(x, P),
-        ),
-        # opt_state mirrors the params (Adam/SGD moments): leave it
-        # UNCONSTRAINED so GSPMD propagates the stage sharding into the
-        # moments — pinning it to P() would replicate ~2x the full model
-        # per device, forfeiting the pipeline's HBM scaling
-        "opt_state": None,
-        "step": NamedSharding(mesh, P()),
-    }
-    tok_spec = NamedSharding(mesh, P(None, data_axis) if data_axis else P())
-    return jax.jit(
-        step_fn,
-        in_shardings=(state_sharding, tok_spec),
-        out_shardings=(state_sharding, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate else (),
+    params_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        param_specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
+    repl = NamedSharding(mesh, P())
+    tok_spec = NamedSharding(mesh, P(None, data_axis) if data_axis else P())
+    # Optimizer moments mirror the stage params and get the SAME stage
+    # sharding (replicating would cost ~2x the model per device; leaving
+    # them unspecified makes jit compile twice). Structure is known only
+    # at call time -> lazy jit, built once.
+    cache: dict = {}
+
+    def call(state, tokens):
+        if "jit" not in cache:
+            from ..training.trainer import opt_state_partition_spec
+
+            opt_sharding = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                opt_state_partition_spec(state["opt_state"], param_specs),
+                is_leaf=lambda s: isinstance(s, P),
+            )
+            state_sharding = {
+                "params": params_sharding,
+                "opt_state": opt_sharding,
+                "step": repl,
+            }
+            cache["jit"] = jax.jit(
+                step_fn,
+                in_shardings=(state_sharding, tok_spec),
+                out_shardings=(state_sharding, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return cache["jit"](state, tokens)
+
+    return call

@@ -79,6 +79,33 @@ def make_classifier_train_step(
     )
 
 
+def opt_state_partition_spec(opt_state, param_spec):
+    """PartitionSpec tree for an optax state: a state leaf whose tree path
+    CONTAINS a param's path (adam's mu/nu mirror the param tree as
+    subtrees) inherits that param's spec; scalar bookkeeping (counts)
+    replicates. Works with prefix specs too (a spec covering a whole
+    subtree, as the pipeline's ``stages`` uses)."""
+    flat_spec, _ = jax.tree_util.tree_flatten_with_path(
+        param_spec, is_leaf=lambda x: isinstance(x, P)
+    )
+    param_paths = [(tuple(p), s) for p, s in flat_spec]
+
+    def spec_for(path) -> P:
+        t = tuple(path)
+        for pp, s in param_paths:
+            if not pp:
+                return s  # single-spec tree covers everything
+            for i in range(len(t) - len(pp) + 1):
+                if t[i : i + len(pp)] == pp:
+                    return s
+        return P()
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(p) for p, _ in leaves]
+    )
+
+
 def _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate):
     """Shared jit wrapper for LM train steps: replicated or TP/EP-sharded
     state, batch over the data axis, donated input state."""
@@ -86,27 +113,49 @@ def _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate):
         return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
     repl = NamedSharding(mesh, P())
-    if param_spec is None:
-        state_sharding = repl
-    else:
-        # opt_state stays replicated here; for adam-scale optimizers shard
-        # it like the params at init time (its mu/nu mirror param shapes).
-        state_sharding = {
-            "params": jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s),
-                param_spec,
-                is_leaf=lambda s: isinstance(s, P),
-            ),
-            "opt_state": repl,
-            "step": repl,
-        }
     batch_shard = NamedSharding(mesh, P(data_axis))
-    return jax.jit(
-        step_fn,
-        in_shardings=(state_sharding, batch_shard),
-        out_shardings=(state_sharding, repl),
-        donate_argnums=(0,) if donate else (),
+    if param_spec is None:
+        return jax.jit(
+            step_fn,
+            in_shardings=(repl, batch_shard),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    # The optimizer moments mirror the params, so they get the SAME
+    # shardings — replicating them would store ~2x the model per device,
+    # and leaving them unspecified lets GSPMD pick per-compile. The state
+    # structure is only known at call time, so the jit is built lazily on
+    # the first step and cached. (One extra compile can still occur at
+    # step 2 from donated-buffer layout changes; steady state is cached.)
+    params_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        param_spec,
+        is_leaf=lambda s: isinstance(s, P),
     )
+    cache: dict = {}
+
+    def call(state, batch):
+        if "jit" not in cache:
+            opt_sharding = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                opt_state_partition_spec(state["opt_state"], param_spec),
+                is_leaf=lambda s: isinstance(s, P),
+            )
+            state_sharding = {
+                "params": params_sharding,
+                "opt_state": opt_sharding,
+                "step": repl,
+            }
+            cache["jit"] = jax.jit(
+                step_fn,
+                in_shardings=(state_sharding, batch_shard),
+                out_shardings=(state_sharding, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return cache["jit"](state, batch)
+
+    return call
 
 
 def make_lm_train_step(

@@ -653,3 +653,32 @@ def test_lm_train_step_vocab_parallel_matches_dense():
         losses[vp_axis] = (float(l1), float(l2))
     assert abs(losses[None][0] - losses["model"][0]) < 1e-4
     assert abs(losses[None][1] - losses["model"][1]) < 1e-4
+
+
+def test_opt_state_partition_spec_mirrors_params():
+    """Adam moments inherit their param's spec; scalar counts replicate;
+    prefix specs (pipeline 'stages') cover whole subtrees."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from devspace_tpu.training.trainer import opt_state_partition_spec
+
+    params = {"layers": [{"wq": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}],
+              "embed": jnp.zeros((8, 4))}
+    spec = {"layers": [{"wq": P(None, "model"), "b": P()}], "embed": P()}
+    opt_state = optax.adamw(1e-3).init(params)
+    osd = opt_state_partition_spec(opt_state, spec)
+    flat = jax.tree_util.tree_flatten_with_path(osd)[0]
+    by_path = {str(p): s for p, s in flat}
+    wq = [s for p, s in flat if "wq" in str(p)]
+    assert wq and all(s == P(None, "model") for s in wq)
+    counts = [s for p, s in flat if "count" in str(p)]
+    assert counts and all(s == P() for s in counts)
+
+    # prefix spec: everything under "stages" inherits P("pipe")
+    params2 = {"stages": {"wq": jnp.zeros((2, 4, 4))}, "embed": jnp.zeros((8,))}
+    spec2 = {"stages": P("pipe"), "embed": P()}
+    osd2 = opt_state_partition_spec(optax.sgd(0.1, momentum=0.9).init(params2), spec2)
+    flat2 = jax.tree_util.tree_flatten_with_path(osd2)[0]
+    wq2 = [s for p, s in flat2 if "wq" in str(p)]
+    assert wq2 and all(s == P("pipe") for s in wq2)
