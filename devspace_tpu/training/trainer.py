@@ -142,15 +142,24 @@ def _jit_lm_step(step_fn, mesh, param_spec, data_axis, donate):
                 opt_state_partition_spec(state["opt_state"], param_spec),
                 is_leaf=lambda s: isinstance(s, P),
             )
-            state_sharding = {
+            out_state_sharding = {
                 "params": params_sharding,
                 "opt_state": opt_sharding,
                 "step": repl,
             }
+            # in: opt_state unconstrained — donated args cannot be
+            # resharded, and callers may init moments replicated OR
+            # already sharded. out: pinned, so from step 1 on the
+            # moments LIVE at their params' shardings.
+            in_state_sharding = {
+                "params": params_sharding,
+                "opt_state": None,
+                "step": repl,
+            }
             cache["jit"] = jax.jit(
                 step_fn,
-                in_shardings=(state_sharding, batch_shard),
-                out_shardings=(state_sharding, repl),
+                in_shardings=(in_state_sharding, batch_shard),
+                out_shardings=(out_state_sharding, repl),
                 donate_argnums=(0,) if donate else (),
             )
         return cache["jit"](state, batch)
