@@ -34,10 +34,14 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # explicit head size override: the tensor-parallel pipeline derives a
+    # per-shard cfg (n_heads/tp local heads) where dim//n_heads no longer
+    # equals the true head size
+    head_dim_override: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
 
 
 LLAMA2_7B = TransformerConfig()
@@ -170,25 +174,42 @@ def default_attention(q, k, v, causal: bool = True):
     return full_attention(q, k, v, causal=causal)
 
 
-def layer_apply(h, layer: dict, cfg: TransformerConfig, cos, sin, attention_fn=None):
+def layer_apply(
+    h,
+    layer: dict,
+    cfg: TransformerConfig,
+    cos,
+    sin,
+    attention_fn=None,
+    pre_block=None,
+    post_block=None,
+):
     """One transformer layer (attn + SwiGLU FFN with pre-RMSNorm residuals)
     -> (h', (k, v)). The single source of truth for the layer math, shared
-    by ``forward`` and the pipeline-parallel stage functions."""
+    by ``forward`` and the pipeline-parallel stage functions.
+
+    ``pre_block``/``post_block`` wrap the entry/exit of each parallel block
+    (after the norm / before the residual add) — the Megatron f/g boundary
+    hooks the tensor-parallel pipeline uses (parallel/pipeline.py); with a
+    per-shard cfg (local head/ffn counts + ``head_dim_override``) the same
+    code runs the sharded math."""
     attn = attention_fn or partial(default_attention, causal=True)
+    pre = pre_block or (lambda x: x)
+    post = post_block or (lambda x: x)
     b, t, _ = h.shape
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
-    x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+    x = pre(rms_norm(h, layer["attn_norm"], cfg.norm_eps))
     q = (x @ layer["wq"]).reshape(b, t, cfg.n_heads, hd)
     k = (x @ layer["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
     v = (x @ layer["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     ctx = attn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
-    h = h + (ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
-    x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+    h = h + post(ctx.reshape(b, t, -1) @ layer["wo"]).astype(h.dtype)
+    x = pre(rms_norm(h, layer["ffn_norm"], cfg.norm_eps))
     gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
-    return h + (gated @ layer["w_down"]).astype(h.dtype), (k, v)
+    return h + post(gated @ layer["w_down"]).astype(h.dtype), (k, v)
 
 
 # -- forward ----------------------------------------------------------------

@@ -180,12 +180,43 @@ def transformer_unstage_params(stage_params: dict) -> dict:
     }
 
 
+def pipeline_param_specs(axis: str = "pipe", tp_axis: str = None) -> dict:
+    """PartitionSpec tree for the staged-transformer layout. Stage groups
+    are sharded over the pipe axis; with ``tp_axis`` the per-layer weights
+    are ADDITIONALLY Megatron-sharded over the model axis (columns for
+    qkv/gate/up, rows for o/down — leaves are [S, K, d_in, d_out])."""
+    if tp_axis is None:
+        return {
+            "embed": P(),
+            "stages": P(axis),
+            "final_norm": P(),
+            "lm_head": P(),
+        }
+    return {
+        "embed": P(),
+        "stages": {
+            "wq": P(axis, None, None, tp_axis),
+            "wk": P(axis, None, None, tp_axis),
+            "wv": P(axis, None, None, tp_axis),
+            "wo": P(axis, None, tp_axis, None),
+            "w_gate": P(axis, None, None, tp_axis),
+            "w_up": P(axis, None, None, tp_axis),
+            "w_down": P(axis, None, tp_axis, None),
+            "attn_norm": P(axis, None, None),
+            "ffn_norm": P(axis, None, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+
+
 def pipeline_lm_loss_and_grads(
     mesh: Mesh,
     cfg,
     n_microbatches: int,
     axis: str = "pipe",
     data_axis: str = None,
+    tp_axis: str = None,
 ):
     """Build ``f(stage_params, tokens) -> (loss, grads)`` running the
     transformer forward+backward under the 1F1B schedule.
@@ -195,17 +226,28 @@ def pipeline_lm_loss_and_grads(
     from transformer_stage_params, sharded over ``axis``. With
     ``data_axis`` set, the microbatch dim (mb) is additionally sharded
     over that mesh axis (PP x DP); loss/grads are psum'd accordingly.
-    Returns the mean loss over all microbatches and a grads pytree shaped
-    like stage_params."""
+    With ``tp_axis`` set, each stage's weights are Megatron-sharded over
+    the model axis and the stage math runs head/ffn-parallel with the
+    f/g boundary ops (PP x DP x TP in ONE program — VERDICT r2 next #2);
+    the f/g custom-vjp pair keeps the 1F1B backward's jax.vjp from ever
+    transposing a raw psum. Returns the mean loss over all microbatches
+    and a grads pytree shaped like stage_params."""
     from ..models.transformer import (
         layer_apply,
         rms_norm,
         rope_frequencies,
     )
     from ..ops.losses import fused_cross_entropy
+    from .tensor_parallel import copy_fwd_psum_bwd, psum_fwd_copy_bwd
 
     n_stages = mesh.shape[axis]
     m_total = n_microbatches
+    tp = mesh.shape[tp_axis] if tp_axis else 1
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp:
+        raise ValueError(
+            f"heads/kv/ffn ({cfg.n_heads}/{cfg.n_kv_heads}/{cfg.ffn_dim}) "
+            f"not divisible by tp={tp}"
+        )
 
     def local_fn(stage_params, tokens):
         stage = jax.lax.axis_index(axis)
@@ -222,9 +264,35 @@ def pipeline_lm_loss_and_grads(
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
+        # Tensor parallelism reuses layer_apply (the single source of
+        # truth for the layer math) with a per-shard cfg: local head/ffn
+        # counts plus the true head size, and the Megatron f/g boundary
+        # ops as the block entry/exit hooks (activations enter sharded
+        # blocks via f = copy-fwd/psum-bwd, leave via g = psum-fwd/
+        # copy-bwd, so h stays replicated over tp).
+        if tp_axis is not None:
+            import dataclasses
+
+            local_cfg = dataclasses.replace(
+                cfg,
+                n_heads=cfg.n_heads // tp,
+                n_kv_heads=cfg.n_kv_heads // tp,
+                ffn_dim=cfg.ffn_dim // tp,
+                head_dim_override=cfg.head_dim,
+            )
+            layer_kwargs = dict(
+                pre_block=lambda x: copy_fwd_psum_bwd(x, tp_axis),
+                post_block=lambda x: psum_fwd_copy_bwd(x, tp_axis),
+            )
+        else:
+            local_cfg = cfg
+            layer_kwargs = {}
+
         def stage_forward(stages_, x):
             def one(h, layer):
-                h, _ = layer_apply(h, layer, cfg, cos, sin)
+                h, _ = layer_apply(
+                    h, layer, local_cfg, cos, sin, **layer_kwargs
+                )
                 return h, None
 
             h, _ = jax.lax.scan(one, x, stages_)
@@ -376,12 +444,7 @@ def pipeline_lm_loss_and_grads(
         }
         return loss, grads
 
-    param_specs = {
-        "embed": P(),
-        "stages": P(axis),
-        "final_norm": P(),
-        "lm_head": P(),
-    }
+    param_specs = pipeline_param_specs(axis, tp_axis)
     tok_spec = P(None, data_axis) if data_axis else P()
     return jax.shard_map(
         local_fn,
@@ -399,18 +462,22 @@ def make_pipeline_lm_train_step(
     n_microbatches: int,
     axis: str = "pipe",
     data_axis: str = None,
+    tp_axis: str = None,
     donate: bool = True,
 ):
     """1F1B pipeline-parallel LM train step: ``step(state, tokens) ->
     (state, loss)`` with state = {params (stage layout), opt_state, step}.
     ``tokens`` [M, mb, T+1]. Loss and grads are mathematically identical
     to the non-pipelined ``make_lm_train_step`` on the unstaged params
-    (equivalence is asserted in tests/test_parallel.py)."""
+    (equivalence is asserted in tests/test_parallel.py). ``data_axis``/
+    ``tp_axis`` compose pp with dp/tp on the same mesh (Megatron-style
+    pp x dp x tp in one jitted program)."""
     import optax
     from jax.sharding import NamedSharding
 
     loss_and_grads = pipeline_lm_loss_and_grads(
-        mesh, cfg, n_microbatches, axis=axis, data_axis=data_axis
+        mesh, cfg, n_microbatches, axis=axis, data_axis=data_axis,
+        tp_axis=tp_axis,
     )
 
     def step_fn(state, tokens):
@@ -426,12 +493,7 @@ def make_pipeline_lm_train_step(
             "step": state["step"] + 1,
         }, loss
 
-    param_specs = {
-        "embed": P(),
-        "stages": P(axis),
-        "final_norm": P(),
-        "lm_head": P(),
-    }
+    param_specs = pipeline_param_specs(axis, tp_axis)
     params_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
         param_specs,

@@ -17,6 +17,62 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# -- Megatron f/g boundary ops ---------------------------------------------
+# The classic pair that makes a column->row parallel block differentiable
+# INSIDE manual (shard_map) code without ever transposing a raw psum:
+#   f = copy_fwd_psum_bwd : marks the block INPUT. Forward is identity;
+#       backward all-reduces the partial input-grads each model shard
+#       produced through its weight shard.
+#   g = psum_fwd_copy_bwd : marks the block OUTPUT. Forward all-reduces
+#       the partial outputs; backward passes the (replicated) cotangent
+#       through unchanged.
+# Used by the pipeline's tensor-parallel stages (parallel/pipeline.py),
+# where the 1F1B backward runs jax.vjp over per-device code.
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _f_op(axis: str):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (jax.lax.psum(ct, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@lru_cache(maxsize=None)
+def _g_op(axis: str):
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    def fwd(x):
+        return jax.lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+def copy_fwd_psum_bwd(x, axis: str):
+    return _f_op(axis)(x)
+
+
+def psum_fwd_copy_bwd(x, axis: str):
+    return _g_op(axis)(x)
+
+
 def shard_columnwise(w: jax.Array, mesh: Mesh, axis: str = "model") -> jax.Array:
     """Shard the output (last) dim of a weight over the model axis."""
     return jax.device_put(w, NamedSharding(mesh, P(None, axis)))

@@ -1,0 +1,85 @@
+"""Actually-executed multi-host bootstrap (VERDICT r2 next #3).
+
+Round 2 tested ``multihost_initialize`` only via monkeypatched env. This
+spawns 2 REAL OS processes (CPU backend, 4 virtual devices each), wires
+the exact env contract the TPU chart injects
+(generator/templates/chart-tpu/templates/statefulset.yaml: coordinator
+address from the headless service, worker id from the pod ordinal,
+hostnames list), and verifies jax.distributed comes up and a
+cross-process psum training step reproduces the single-process math.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _reference_losses() -> tuple[float, float]:
+    """The same two SGD steps in plain numpy (no mesh, no processes)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.normal(size=(16,)).astype(np.float32)
+    w = np.zeros((8,), np.float32)
+    losses = []
+    for _ in range(2):
+        resid = x @ w - y
+        losses.append(float(np.mean(resid**2)))
+        w = w - 0.5 * (2.0 / 16.0) * (x.T @ resid)
+    return losses[0], losses[1]
+
+
+@pytest.mark.slow
+def test_two_process_bootstrap_trains_psum_step():
+    port = _free_port()
+    hostnames = "worker-0.svc,worker-1.svc"  # chart-style hostnames list
+    procs = []
+    for wid in range(2):
+        env = dict(
+            os.environ,
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            TPU_WORKER_ID=str(wid),
+            TPU_WORKER_HOSTNAMES=hostnames,
+            PYTHONPATH=REPO,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multi-host bootstrap wedged (300s)")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-3000:]}"
+    ref0, ref1 = _reference_losses()
+    for rc, out, err in outs:
+        line = [l for l in out.splitlines() if l.startswith("MULTIHOST_LOSS ")]
+        assert line, out
+        _, l0, l1 = line[0].split()
+        assert abs(float(l0) - ref0) < 1e-5, (l0, ref0)
+        assert abs(float(l1) - ref1) < 1e-5, (l1, ref1)
+        assert float(l1) < float(l0)  # training actually descended

@@ -563,6 +563,87 @@ def test_pipeline_1f1b_transformer_equivalence():
     assert float(l2) < float(l1)
 
 
+def test_pipeline_1f1b_dp_tp_composed():
+    """VERDICT r2 next #2: the 1F1B transformer train step on a
+    {pipe: 2, data: 2, model: 2} mesh using all 8 devices — microbatches
+    sharded over ``data``, stage weights Megatron-sharded over ``model``
+    — must reproduce the non-pipelined loss and grads."""
+    import dataclasses
+
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.ops.losses import fused_cross_entropy
+    from devspace_tpu.parallel.mesh import create_mesh
+    from devspace_tpu.parallel.pipeline import (
+        make_pipeline_lm_train_step,
+        pipeline_lm_loss_and_grads,
+        pipeline_param_specs,
+        transformer_stage_params,
+        transformer_unstage_params,
+    )
+
+    cfg = dataclasses.replace(tfm.TINY, dtype=jnp.float32, n_layers=2)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    S, M, mb, T = 2, 4, 2, 16
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (M, mb, T + 1), 0, cfg.vocab_size
+    )
+    flat = tokens.reshape(M * mb, T + 1)
+
+    def loss_fn(p):
+        logits = tfm.forward(p, flat[:, :-1], cfg)
+        b, t, v = logits.shape
+        return jnp.mean(
+            fused_cross_entropy(logits.reshape(b * t, v), flat[:, 1:].reshape(-1))
+        )
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params)
+
+    mesh = create_mesh({"pipe": S, "data": 2, "model": 2})
+    specs = pipeline_param_specs("pipe", tp_axis="model")
+    staged = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        transformer_stage_params(params, S),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    sharded_tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(None, "data"))
+    )
+    loss, grads = jax.jit(
+        pipeline_lm_loss_and_grads(
+            mesh, cfg, M, data_axis="data", tp_axis="model"
+        )
+    )(staged, sharded_tokens)
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+
+    unstaged = transformer_unstage_params(grads)
+    for (pa, ga), (pb, gb) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(unstaged)[0],
+    ):
+        assert pa == pb
+        denom = float(jnp.max(jnp.abs(ga))) + 1e-9
+        rel = float(jnp.max(jnp.abs(ga - gb))) / denom
+        assert rel < 1e-4, (pa, rel)
+
+    # the composed train step runs and reduces the loss
+    opt = optax.adam(1e-2)
+    state = {
+        "params": staged,
+        "opt_state": opt.init(staged),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_pipeline_lm_train_step(
+        mesh, cfg, opt, M, data_axis="data", tp_axis="model"
+    )
+    state, l1 = step(state, sharded_tokens)
+    state, l2 = step(state, sharded_tokens)
+    assert float(l2) < float(l1)
+
+
 def test_pipeline_stage_params_roundtrip():
     import dataclasses
 
