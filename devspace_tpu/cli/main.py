@@ -312,6 +312,11 @@ def cmd_status(args) -> int:
                     state = "Error"
                 elif st.get("running") and age < 600:
                     state = "Active"  # age guard: killed -9 never unpublishes
+                elif st.get("running"):
+                    # claims running but stale despite the session's 120s
+                    # heartbeat — likely a killed process, but don't assert
+                    # what we can't know
+                    state = "Unknown"
                 else:
                     state = "Stopped"
                 rows.append(
@@ -910,6 +915,127 @@ def cmd_update(args) -> int:
     return 0
 
 
+def _chart_deployers(ctx):
+    """(deployment, ChartDeployer) for every chart deployment."""
+    from ..deploy.chart import ChartDeployer
+    from ..deploy.manifests import create_deployer
+
+    out = []
+    for d in ctx.config.deployments or []:
+        deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
+        if isinstance(deployer, ChartDeployer):
+            out.append((d, deployer))
+    return out
+
+
+def cmd_update_packages(args) -> int:
+    """Refresh package repo indexes and report/apply newer vendored chart
+    versions (reference: helm/client.go:169 UpdateRepos; vendoring makes
+    the refresh an explicit command)."""
+    from ..deploy.packages import PackageError, check_updates, upgrade_package
+
+    ctx = Context(args)
+    log = ctx.log
+    rows = []
+    rc = 0
+    index_cache: dict = {}
+    matched = False
+    for d, deployer in _chart_deployers(ctx):
+        chart_dir = deployer.chart_path
+        for row in check_updates(chart_dir, index_cache=index_cache):
+            if args.name and row["name"] != args.name:
+                continue
+            matched = True
+            state = (
+                row["error"]
+                or ("update available" if row["update"] else "up to date")
+            )
+            current = row["current"]
+            if row["error"]:
+                rc = 1
+            elif row["update"] and getattr(args, "apply", False):
+                try:
+                    upgrade_package(
+                        chart_dir, row["name"], logger=log,
+                        index_cache=index_cache,
+                    )
+                    current = row["latest"]
+                    state = f"upgraded from {row['current']}"
+                except PackageError as e:
+                    log.error("[update] %s: %s", row["name"], e)
+                    state = f"upgrade failed: {e}"
+                    rc = 1
+            rows.append(
+                [d.name, row["name"], current, row["latest"], state]
+            )
+    if args.name and not matched:
+        log.error("[update] package '%s' is not vendored here", args.name)
+        return 1
+    if not rows:
+        log.info("[update] no vendored packages found")
+        return 0
+    logutil.get_logger().print_table(
+        ["DEPLOYMENT", "PACKAGE", "CURRENT", "LATEST", "STATE"], rows
+    )
+    return rc
+
+
+def cmd_lint(args) -> int:
+    """Validate charts/manifests without applying: render every deployment
+    with its configured values (the exact deploy render path), check the
+    rendered objects structurally, and check TPU slice invariants at
+    render time (the live-pod versions live in `analyze`)."""
+    from ..deploy.chart import ChartError
+    from ..deploy.lint import lint_chart, lint_tpu_consistency, validate_manifests
+    from ..deploy.manifests import create_deployer
+
+    log = logutil.get_logger()
+    if getattr(args, "chart", None):
+        # standalone chart dir (no project config needed)
+        issues = [f"{args.chart}: {i}" for i in lint_chart(args.chart)]
+        for issue in issues:
+            log.warn("[lint] %s", issue)
+        if issues:
+            log.error("[lint] %d issue(s)", len(issues))
+            return 1
+        log.done("[lint] %s clean", args.chart)
+        return 0
+
+    ctx = Context(args)
+    cache = ctx.loader.generated.get_active().deploy
+    image_tags = dict(cache.image_tags or {})
+    for k, v in (ctx.config.images or {}).items():
+        if v.image:
+            image_tags.setdefault(k, f"{v.image}:dev")
+    issues: list[str] = []
+    all_docs: list[dict] = []
+    from ..deploy.chart import ChartDeployer
+
+    for d in ctx.config.deployments or []:
+        deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
+        try:
+            if isinstance(deployer, ChartDeployer):
+                docs = deployer.render_manifests(
+                    image_tags=image_tags, tpu=ctx.config.tpu
+                )
+            else:
+                docs = deployer.render_manifests(image_tags=image_tags)
+        except (ChartError, OSError) as e:
+            issues.append(f"{d.name}: render failed: {e}")
+            continue
+        issues.extend(f"{d.name}: {i}" for i in validate_manifests(docs))
+        all_docs.extend(docs)
+    # slice invariants span deployments (the tpu block is config-global)
+    issues.extend(lint_tpu_consistency(all_docs, ctx.config.tpu))
+    for issue in issues:
+        log.warn("[lint] %s", issue)
+    if issues:
+        log.error("[lint] %d issue(s) across %d object(s)", len(issues), len(all_docs))
+        return 1
+    log.done("[lint] %d object(s), no issues", len(all_docs))
+    return 0
+
+
 def _checkout_root() -> str:
     """Repo checkout containing the devspace_tpu package (cli/ -> package
     -> checkout)."""
@@ -1224,8 +1350,29 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--no-use", action="store_true", help="create without binding")
     q.set_defaults(fn=cmd_create)
 
-    sp = sub.add_parser("update", help="rewrite config at the latest schema")
+    sp = sub.add_parser(
+        "update", help="update config schema / refresh package indexes"
+    )
+    up_sub = sp.add_subparsers(dest="kind")
+    q = up_sub.add_parser("config", help="rewrite config at the latest schema")
+    q.set_defaults(fn=cmd_update)
+    q = up_sub.add_parser(
+        "packages", help="check chart repos for newer vendored versions"
+    )
+    q.add_argument("name", nargs="?", help="limit to one package")
+    q.add_argument(
+        "--apply", action="store_true", help="re-vendor newer versions"
+    )
+    q.set_defaults(fn=cmd_update_packages)
     sp.set_defaults(fn=cmd_update)
+
+    sp = sub.add_parser(
+        "lint", help="validate charts/manifests without applying"
+    )
+    sp.add_argument(
+        "--chart", help="lint a standalone chart dir instead of the project"
+    )
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("upgrade", help="upgrade the framework checkout")
     sp.add_argument("--apply", action="store_true", help="run git pull")

@@ -291,6 +291,126 @@ def _num(v: Any):
         return 0
 
 
+def _num_strict(v: Any):
+    """Arithmetic/comparison operand coercion that FAILS the render on
+    garbage (real helm errors out with a diagnostic rather than silently
+    comparing against 0; sprig's atoi-style `int`/`int64` casts keep the
+    permissive _num above)."""
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return v
+    try:
+        f = float(v)
+        return int(f) if f == int(f) else f
+    except (TypeError, ValueError):
+        raise TemplateError(
+            f"non-numeric operand in arithmetic/comparison: {v!r}"
+        ) from None
+
+
+def _div_go(a, b):
+    """Go's integer division truncates toward zero (Python's // floors:
+    -7 // 2 == -4 but Go gives -3)."""
+    na, nb = _num_strict(a), _num_strict(b)
+    if nb == 0:
+        raise TemplateError("division by zero in template")
+    if isinstance(na, int) and isinstance(nb, int):
+        q = abs(na) // abs(nb)
+        return q if (na >= 0) == (nb >= 0) else -q
+    return na / nb
+
+
+def _mod_go(a, b):
+    """Go's % truncates toward zero (result takes the dividend's sign)."""
+    import math
+
+    na, nb = _num_strict(a), _num_strict(b)
+    if nb == 0:
+        raise TemplateError("division by zero in template (mod)")
+    if isinstance(na, int) and isinstance(nb, int):
+        return int(math.fmod(na, nb))
+    return math.fmod(na, nb)
+
+
+def _semver_parse(v: Any) -> tuple[int, int, int]:
+    """Lenient semver core parse: 'v1.27.3-gke.100' -> (1, 27, 3)."""
+    s = str(v).strip().lstrip("vV")
+    core = s.split("-", 1)[0].split("+", 1)[0]
+    parts: list[int] = []
+    for p in core.split("."):
+        digits = re.match(r"\d+", p)
+        parts.append(int(digits.group()) if digits else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return parts[0], parts[1], parts[2]
+
+
+def _semver_compare(constraint: Any, version: Any) -> bool:
+    """Masterminds/semver-style constraint check (the sprig function
+    charts use to pick manifests per Capabilities.KubeVersion): supports
+    >=, >, <=, <, =, !=, ~, ^, wildcard/partial versions, comma/space
+    AND lists, || OR groups and 'A - B' hyphen ranges."""
+    ver = _semver_parse(version)
+    text = str(constraint).strip()
+    if not text:
+        return True
+    # hyphen range: "1.2 - 2.0" == ">=1.2 <=2.0"
+    text = re.sub(
+        r"(\S+)\s+-\s+(\S+)", lambda m: f">={m.group(1)} <={m.group(2)}", text
+    )
+    # ">= 1.25" (spaced operator) must not split into two terms
+    text = re.sub(r"(>=|<=|==|!=|>|<|=|~|\^)\s+", r"\1", text)
+    for group in text.split("||"):
+        terms = [t for t in re.split(r"[,\s]+", group.strip()) if t]
+        group_ok = True
+        for term in terms:
+            m = re.match(r"^(>=|<=|==|!=|>|<|=|~|\^)?\s*(.+)$", term)
+            if not m:
+                raise TemplateError(f"bad semver constraint: {term!r}")
+            op = m.group(1) or "="
+            target_s = m.group(2)
+            tgt = _semver_parse(target_s)
+            nfields = len(
+                [
+                    p
+                    for p in target_s.lstrip("vV").split("-")[0].split(".")
+                    if p not in ("", "*", "x", "X")
+                ]
+            )
+            if op == ">=":
+                ok = ver >= tgt
+            elif op == ">":
+                ok = ver > tgt
+            elif op == "<=":
+                ok = ver <= tgt
+            elif op == "<":
+                ok = ver < tgt
+            elif op == "!=":
+                ok = ver != tgt
+            elif op == "~":
+                upper = (
+                    (tgt[0], tgt[1] + 1, 0) if nfields >= 2 else (tgt[0] + 1, 0, 0)
+                )
+                ok = tgt <= ver < upper
+            elif op == "^":
+                if tgt[0] > 0:
+                    upper = (tgt[0] + 1, 0, 0)
+                elif tgt[1] > 0:
+                    upper = (0, tgt[1] + 1, 0)
+                else:
+                    upper = (0, 0, tgt[2] + 1)
+                ok = tgt <= ver < upper
+            else:  # exact / wildcard prefix ("1.2" matches any 1.2.x)
+                ok = ver[:nfields] == tgt[:nfields] if nfields else True
+            if not ok:
+                group_ok = False
+                break
+        if group_ok:
+            return True
+    return False
+
+
 def _cmp_ok(a, b) -> bool:
     try:
         return bool(a == b)
@@ -312,20 +432,18 @@ def _build_functions(renderer: "Renderer") -> dict[str, Callable]:
         "or": lambda *a: next((x for x in a if _truthy(x)), a[-1]),
         "eq": lambda a, *bs: any(_cmp_ok(a, b) for b in bs),
         "ne": lambda a, b: not _cmp_ok(a, b),
-        "lt": lambda a, b: _num(a) < _num(b),
-        "le": lambda a, b: _num(a) <= _num(b),
-        "gt": lambda a, b: _num(a) > _num(b),
-        "ge": lambda a, b: _num(a) >= _num(b),
-        "add": lambda *a: sum(_num(x) for x in a),
-        "add1": lambda a: _num(a) + 1,
-        "sub": lambda a, b: _num(a) - _num(b),
-        "mul": lambda *a: __import__("math").prod(_num(x) for x in a),
-        "div": lambda a, b: _num(a) // _num(b)
-        if isinstance(_num(a), int) and isinstance(_num(b), int)
-        else _num(a) / _num(b),
-        "mod": lambda a, b: _num(a) % _num(b),
-        "min": lambda *a: min(_num(x) for x in a),
-        "max": lambda *a: max(_num(x) for x in a),
+        "lt": lambda a, b: _num_strict(a) < _num_strict(b),
+        "le": lambda a, b: _num_strict(a) <= _num_strict(b),
+        "gt": lambda a, b: _num_strict(a) > _num_strict(b),
+        "ge": lambda a, b: _num_strict(a) >= _num_strict(b),
+        "add": lambda *a: sum(_num_strict(x) for x in a),
+        "add1": lambda a: _num_strict(a) + 1,
+        "sub": lambda a, b: _num_strict(a) - _num_strict(b),
+        "mul": lambda *a: __import__("math").prod(_num_strict(x) for x in a),
+        "div": _div_go,
+        "mod": _mod_go,
+        "min": lambda *a: min(_num_strict(x) for x in a),
+        "max": lambda *a: max(_num_strict(x) for x in a),
         "int": lambda v: int(_num(v)),
         "int64": lambda v: int(_num(v)),
         "float64": lambda v: float(_num(v)),
@@ -424,7 +542,10 @@ def _build_functions(renderer: "Renderer") -> dict[str, Callable]:
         "regexReplaceAll": lambda pat, s, repl: re.sub(
             pat, re.sub(r"\$\{(\w+)\}", r"\\g<\1>", repl), str(s)
         ),
-        "semverCompare": lambda constraint, version: True,  # permissive stub
+        "semverCompare": _semver_compare,
+        "semver": lambda v: dict(
+            zip(("Major", "Minor", "Patch"), _semver_parse(v))
+        ),
         "lookup": lambda *a: {},  # no live-cluster lookups at render time
         "tpl": lambda s, ctx: renderer._render_string(str(s), ctx),
         "include": lambda name, ctx: renderer._include(name, ctx),

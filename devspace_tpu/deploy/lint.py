@@ -1,0 +1,241 @@
+"""Chart/config lint — validate before touching the cluster.
+
+Reference parity: helm's client-side checks before install
+(``/root/reference/pkg/devspace/helm/install.go:54`` loads + requirement-
+checks the chart; ``helm lint`` upstream renders with default values and
+schema-checks the objects). TPU-first addition: the render-time half of
+analyze's slice preflights (``analyze/analyze.py:analyze_tpu_slice``
+checks live pods; lint checks the SAME invariants on the rendered
+manifests, so a broken topology is caught before anything is applied).
+
+Three layers:
+- ``validate_manifests`` — structural object checks (apiVersion/kind/
+  metadata, DNS-1123 names, duplicate ids, container images, selector
+  wiring, workload basics);
+- ``lint_tpu_consistency`` — slice invariants for configs with a
+  ``tpu:`` block (worker count vs replicas, topology product vs chips,
+  google.com/tpu resources, TPU_WORKER_ID/HOSTNAMES/coordinator env
+  wiring, headless-service discovery);
+- ``lint_chart`` / ``lint_deployments`` — render (defaults + provided
+  values, the SAME path deploy uses) then run both check layers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..config import latest
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_WORKLOAD_KINDS = {
+    "Deployment",
+    "StatefulSet",
+    "DaemonSet",
+    "Job",
+    "ReplicaSet",
+}
+
+
+def _containers(doc: dict) -> list[dict]:
+    spec = doc.get("spec") or {}
+    if doc.get("kind") == "Pod":
+        return (spec.get("containers") or []) + (spec.get("initContainers") or [])
+    tmpl = (spec.get("template") or {}).get("spec") or {}
+    return (tmpl.get("containers") or []) + (tmpl.get("initContainers") or [])
+
+
+def _pod_spec(doc: dict) -> dict:
+    spec = doc.get("spec") or {}
+    if doc.get("kind") == "Pod":
+        return spec
+    return (spec.get("template") or {}).get("spec") or {}
+
+
+def validate_manifests(docs: list[dict]) -> list[str]:
+    """Structural checks every rendered object must pass. Returns issue
+    strings ('' prefix-tagged with KIND/name so reports read well)."""
+    issues: list[str] = []
+    seen: set[tuple[str, str, str]] = set()
+    for i, doc in enumerate(docs):
+        if not isinstance(doc, dict) or not doc:
+            issues.append(f"document #{i}: not a mapping ({type(doc).__name__})")
+            continue
+        kind = doc.get("kind")
+        api = doc.get("apiVersion")
+        meta = doc.get("metadata") or {}
+        name = meta.get("name")
+        label = f"{kind or '?'}/{name or f'#{i}'}"
+        if not api:
+            issues.append(f"{label}: missing apiVersion")
+        if not kind:
+            issues.append(f"{label}: missing kind")
+        if not name:
+            issues.append(f"{label}: missing metadata.name")
+        elif not _DNS1123.match(str(name)) or len(str(name)) > 253:
+            issues.append(f"{label}: metadata.name not DNS-1123 ({name!r})")
+        if kind and name:
+            key = (str(kind), str(name), str(meta.get("namespace") or ""))
+            if key in seen:
+                issues.append(f"{label}: duplicate object (kind+name+namespace)")
+            seen.add(key)
+        for c in _containers(doc):
+            cname = c.get("name") or "?"
+            if not c.get("name"):
+                issues.append(f"{label}: container without a name")
+            if not c.get("image"):
+                issues.append(f"{label}: container {cname} has no image")
+        if kind in _WORKLOAD_KINDS and kind != "DaemonSet":
+            sel = ((doc.get("spec") or {}).get("selector") or {}).get(
+                "matchLabels"
+            ) or {}
+            tmpl_labels = (
+                ((doc.get("spec") or {}).get("template") or {}).get("metadata")
+                or {}
+            ).get("labels") or {}
+            if sel and any(tmpl_labels.get(k) != v for k, v in sel.items()):
+                issues.append(
+                    f"{label}: selector.matchLabels not matched by "
+                    f"template labels ({sel} vs {tmpl_labels})"
+                )
+        if kind == "StatefulSet":
+            svc = (doc.get("spec") or {}).get("serviceName")
+            if not svc:
+                issues.append(f"{label}: StatefulSet without serviceName")
+            else:
+                has_headless = any(
+                    isinstance(d, dict)
+                    and d.get("kind") == "Service"
+                    and (d.get("metadata") or {}).get("name") == svc
+                    and (d.get("spec") or {}).get("clusterIP") in (None, "None")
+                    for d in docs
+                )
+                if not has_headless:
+                    issues.append(
+                        f"{label}: serviceName '{svc}' has no (headless) "
+                        f"Service in the rendered objects"
+                    )
+    return issues
+
+
+def lint_tpu_consistency(
+    docs: list[dict], tpu: Optional[latest.TPUConfig]
+) -> list[str]:
+    """Render-time slice invariants (live-pod versions of the same checks:
+    analyze/analyze.py:analyze_tpu_slice)."""
+    if tpu is None or not (tpu.workers or tpu.topology or tpu.accelerator):
+        return []
+    issues: list[str] = []
+    workers = tpu.workers or 1
+    chips_per_worker = tpu.chips_per_worker or 1
+    # topology product vs slice chips
+    if tpu.topology:
+        try:
+            product = 1
+            for part in str(tpu.topology).lower().split("x"):
+                product *= int(part)
+        except ValueError:
+            issues.append(f"tpu: unparseable topology {tpu.topology!r}")
+            product = None
+        if product is not None and product != workers * chips_per_worker:
+            issues.append(
+                f"tpu: topology {tpu.topology} has {product} chips but "
+                f"workers x chipsPerWorker = {workers * chips_per_worker}"
+            )
+    slice_workloads = 0
+    for doc in docs:
+        if not isinstance(doc, dict) or doc.get("kind") not in _WORKLOAD_KINDS:
+            continue
+        pod = _pod_spec(doc)
+        containers = _containers(doc)
+        requests_tpu = any(
+            "google.com/tpu" in ((c.get("resources") or {}).get("limits") or {})
+            or "google.com/tpu"
+            in ((c.get("resources") or {}).get("requests") or {})
+            for c in containers
+        )
+        env_names = {
+            e.get("name")
+            for c in containers
+            for e in c.get("env") or []
+            if isinstance(e, dict)
+        }
+        is_slice = requests_tpu or {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"} & env_names
+        if not is_slice:
+            continue
+        slice_workloads += 1
+        label = f"{doc.get('kind')}/{(doc.get('metadata') or {}).get('name')}"
+        replicas = (doc.get("spec") or {}).get("replicas")
+        if replicas is not None and int(replicas) != workers:
+            issues.append(
+                f"{label}: replicas {replicas} != tpu.workers {workers} "
+                f"(slice atomicity: every worker pod must exist)"
+            )
+        if not requests_tpu:
+            issues.append(
+                f"{label}: TPU env wired but no container requests "
+                f"google.com/tpu resources"
+            )
+        for want in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"):
+            if want not in env_names:
+                issues.append(f"{label}: missing {want} env")
+        if workers > 1 and "JAX_COORDINATOR_ADDRESS" not in env_names:
+            issues.append(
+                f"{label}: multi-worker slice without JAX_COORDINATOR_ADDRESS"
+            )
+        if doc.get("kind") != "StatefulSet" and workers > 1:
+            issues.append(
+                f"{label}: multi-worker slices need stable identities — "
+                f"use a StatefulSet (got {doc.get('kind')})"
+            )
+        # static hostname lists must match the worker count
+        for c in containers:
+            for e in c.get("env") or []:
+                if (
+                    isinstance(e, dict)
+                    and e.get("name") == "TPU_WORKER_HOSTNAMES"
+                    and isinstance(e.get("value"), str)
+                    and e["value"]
+                ):
+                    got = len([h for h in e["value"].split(",") if h])
+                    if got != workers:
+                        issues.append(
+                            f"{label}: TPU_WORKER_HOSTNAMES lists {got} "
+                            f"host(s), expected {workers}"
+                        )
+    if slice_workloads == 0:
+        issues.append(
+            "tpu: config has a tpu block but no rendered workload requests "
+            "google.com/tpu or wires TPU_WORKER_ID/TPU_WORKER_HOSTNAMES"
+        )
+    return issues
+
+
+def lint_chart(
+    chart_path: str,
+    release_name: str = "lint",
+    namespace: str = "default",
+    values: Optional[dict] = None,
+    value_files: Optional[list[str]] = None,
+    tpu: Optional[latest.TPUConfig] = None,
+    extra_context: Optional[dict] = None,
+) -> list[str]:
+    """Render a chart (defaults + provided values) and run all checks.
+    A render failure is itself the lint finding."""
+    from .chart import ChartError, render_chart
+    from .gotemplate import TemplateError
+
+    try:
+        docs = render_chart(
+            chart_path,
+            release_name=release_name,
+            namespace=namespace,
+            values=values,
+            value_files=value_files,
+            extra_context=extra_context,
+        )
+    except (ChartError, TemplateError, OSError) as e:
+        return [f"render failed: {e}"]
+    issues = validate_manifests(docs)
+    issues.extend(lint_tpu_consistency(docs, tpu))
+    return issues

@@ -131,8 +131,16 @@ def search_charts(repo: str, query: str = "") -> list[ChartEntry]:
     return hits
 
 
-def resolve(repo: str, name: str, version: Optional[str] = None) -> ChartEntry:
-    index = load_index(repo)
+def resolve(
+    repo: str,
+    name: str,
+    version: Optional[str] = None,
+    index: Optional[dict[str, list[ChartEntry]]] = None,
+) -> ChartEntry:
+    """Pick a chart entry. ``index`` lets callers reuse an already-loaded
+    index (check_updates/--apply hit the same repo once, not per-dep)."""
+    if index is None:
+        index = load_index(repo)
     entries = index.get(name)
     if not entries:
         available = ", ".join(sorted(index)) or "none"
@@ -167,8 +175,17 @@ def _fetch_chart(repo: str, entry: ChartEntry, dest: str) -> None:
             f"chart '{entry.name}' {entry.version}: http repos need an 'archive' entry"
         )
     # `urls:` entries in upstream helm indexes may be absolute — fetch
-    # those verbatim (no re-quoting: signed/encoded URLs must not change)
+    # those verbatim (no re-quoting: signed/encoded URLs must not change).
+    # Scheme-restricted: an index is untrusted input, and a file:// (or
+    # other-scheme) absolute URL would read local files into the vendored
+    # chart dir.
     if _is_url(entry.archive):
+        scheme = urllib.parse.urlparse(entry.archive).scheme
+        if scheme not in ("http", "https"):
+            raise PackageError(
+                f"chart archive URL scheme '{scheme}' not allowed "
+                f"(http/https only): {entry.archive}"
+            )
         try:
             with urllib.request.urlopen(entry.archive, timeout=30) as resp:
                 blob = resp.read()
@@ -299,6 +316,127 @@ def remove_package(
     else:
         log.warn("[package] %s not found", name)
     return removed
+
+
+def check_updates(
+    chart_dir: str, index_cache: Optional[dict] = None
+) -> list[dict]:
+    """Refresh every requirement's repo index and report newer versions
+    (reference: helm/client.go:169 UpdateRepos refreshes repo indexes
+    before installs; vendoring makes this an explicit command here).
+    ``index_cache`` ({repo: index}) dedupes fetches when several deps
+    share a repo and lets --apply reuse the same indexes. Returns
+    [{name, current, latest, repository, update, error}]."""
+    cache = index_cache if index_cache is not None else {}
+    out = []
+    for dep in load_requirements(chart_dir):
+        name = dep.get("name", "?")
+        repo = dep.get("repository", "")
+        current = str(dep.get("version", "?"))
+        row = {
+            "name": name,
+            "current": current,
+            "latest": current,
+            "repository": repo,
+            "update": False,
+            "error": "",
+        }
+        try:
+            if repo not in cache:
+                cache[repo] = load_index(repo)
+            newest = resolve(repo, name, index=cache[repo])
+            row["latest"] = newest.version
+            row["update"] = _version_key(newest.version) > _version_key(current)
+        except PackageError as e:
+            row["error"] = str(e)
+        out.append(row)
+    return out
+
+
+def upgrade_package(
+    chart_dir: str,
+    name: str,
+    version: Optional[str] = None,
+    logger: Optional[logutil.Logger] = None,
+    index_cache: Optional[dict] = None,
+) -> ChartEntry:
+    """Re-vendor a package at ``version`` (default: newest in its repo).
+    The user's ``packages.<name>`` overrides in the parent values.yaml are
+    preserved; NEW default keys from the upgraded chart are added without
+    clobbering existing ones."""
+    log = logger or logutil.get_logger()
+    deps = load_requirements(chart_dir)
+    dep = next((d for d in deps if d.get("name") == name), None)
+    if dep is None:
+        raise PackageError(f"package '{name}' is not in {REQUIREMENTS_FILE}")
+    repo = dep.get("repository", "")
+    old_version = str(dep.get("version", "?"))
+    index = (index_cache or {}).get(repo)
+    entry = resolve(repo, name, version, index=index)
+    if entry.version == old_version:
+        log.info("[package] %s already at %s", name, entry.version)
+        return entry
+    dest = os.path.join(chart_dir, PACKAGES_DIR, name)
+    backup = None
+    if os.path.isdir(dest):
+        backup = dest + ".upgrading"
+        if os.path.isdir(backup):
+            shutil.rmtree(backup)
+        os.rename(dest, backup)
+    try:
+        _fetch_chart(repo, entry, dest)
+    except BaseException:
+        if backup:  # restore the old vendored chart on any failure
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            os.rename(backup, dest)
+        raise
+    if backup:
+        shutil.rmtree(backup)
+    dep["version"] = entry.version
+    _save_requirements(chart_dir, deps)
+
+    # merge NEW defaults under packages.<name> without overwriting the
+    # user's existing values; only rewrite values.yaml when the merge
+    # actually added something (safe_dump strips the user's comments and
+    # formatting — don't pay that for a no-op)
+    pkg_values_path = os.path.join(dest, "values.yaml")
+    parent_values_path = os.path.join(chart_dir, "values.yaml")
+    new_defaults = {}
+    if os.path.isfile(pkg_values_path):
+        with open(pkg_values_path, "r", encoding="utf-8") as fh:
+            new_defaults = yaml.safe_load(fh) or {}
+    parent_values = {}
+    if os.path.isfile(parent_values_path):
+        with open(parent_values_path, "r", encoding="utf-8") as fh:
+            parent_values = yaml.safe_load(fh) or {}
+    # tolerate null `packages:` / `packages.<name>:` keys
+    packages = parent_values.get("packages") or {}
+    parent_values["packages"] = packages
+    current = packages.get(name) or {}
+    packages[name] = current
+    if _merge_missing(current, new_defaults):
+        log.warn(
+            "[package] values.yaml rewritten with %s's new default keys "
+            "(comments/formatting are not preserved)", name
+        )
+        with open(parent_values_path, "w", encoding="utf-8") as fh:
+            yaml.safe_dump(parent_values, fh, sort_keys=False)
+    log.done("[package] upgraded %s %s -> %s", name, old_version, entry.version)
+    return entry
+
+
+def _merge_missing(dst: dict, src: dict) -> bool:
+    """Recursively add keys from src absent in dst (never overwrite).
+    Returns True if anything was added."""
+    changed = False
+    for k, v in (src or {}).items():
+        if k not in dst:
+            dst[k] = v
+            changed = True
+        elif isinstance(dst[k], dict) and isinstance(v, dict):
+            changed |= _merge_missing(dst[k], v)
+    return changed
 
 
 def list_packages(chart_dir: str) -> list[dict]:

@@ -257,7 +257,20 @@ class SyncSession:
             )
             self._threads.append(t_verify)
             t_verify.start()
+        # Heartbeat: republish status on a timer so a healthy-but-idle
+        # session (no sync events for >10 min — common for single-worker
+        # sessions that never start the verify loop) is not reported
+        # Stopped by `status sync`'s staleness guard.
+        t_hb = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="sync-heartbeat"
+        )
+        self._threads.append(t_hb)
+        t_hb.start()
         self._publish_status()
+
+    def _heartbeat_loop(self, interval: float = 120.0) -> None:
+        while not self._stopped.wait(interval):
+            self._publish_status()
 
     def stop(self, error: Optional[BaseException] = None) -> None:
         if error is not None and self.error is None:
@@ -944,8 +957,11 @@ class SyncSession:
         """Write per-session/per-worker state to opts.status_path (JSON,
         atomic rename) so out-of-process `status sync` sees live health.
         The file is shared by every session in the project: a process-wide
-        lock serializes read-modify-write, and the temp file name is
-        unique per process so two CLIs can't corrupt each other."""
+        lock serializes threads, an fcntl flock on a sidecar lock file
+        serializes read-modify-write ACROSS devspace processes (two CLIs
+        publishing concurrently could otherwise interleave read->replace
+        and silently drop each other's entry), and the temp file name is
+        unique per process so rename never corrupts."""
         path = self.opts.status_path
         if not path:
             return
@@ -954,25 +970,38 @@ class SyncSession:
         with _STATUS_FILE_LOCK:
             try:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = f"{path}.{os.getpid()}.tmp"
-                existing: dict = {}
+                lock_fh = open(f"{path}.lock", "a+", encoding="utf-8")
                 try:
-                    with open(path, "r", encoding="utf-8") as fh:
-                        existing = json.load(fh)
-                except (OSError, ValueError):
-                    existing = {}
-                # prune entries from long-gone runs (removed sync configs)
-                cutoff = time.time() - 24 * 3600
-                existing = {
-                    k: v
-                    for k, v in existing.items()
-                    if (v.get("updated_at") or 0) > cutoff
-                }
-                key = f"{self.opts.local_path}->{self.opts.container_path}"
-                existing[key] = self.status_snapshot()
-                with open(tmp, "w", encoding="utf-8") as fh:
-                    json.dump(existing, fh, indent=1)
-                os.replace(tmp, path)
+                    try:
+                        import fcntl
+
+                        fcntl.flock(lock_fh, fcntl.LOCK_EX)
+                    except (ImportError, OSError):
+                        # non-POSIX, or a filesystem without flock (some
+                        # NFS mounts): publish anyway — the cross-process
+                        # lock is an upgrade, not a prerequisite
+                        pass
+                    tmp = f"{path}.{os.getpid()}.tmp"
+                    existing: dict = {}
+                    try:
+                        with open(path, "r", encoding="utf-8") as fh:
+                            existing = json.load(fh)
+                    except (OSError, ValueError):
+                        existing = {}
+                    # prune entries from long-gone runs (removed sync configs)
+                    cutoff = time.time() - 24 * 3600
+                    existing = {
+                        k: v
+                        for k, v in existing.items()
+                        if (v.get("updated_at") or 0) > cutoff
+                    }
+                    key = f"{self.opts.local_path}->{self.opts.container_path}"
+                    existing[key] = self.status_snapshot()
+                    with open(tmp, "w", encoding="utf-8") as fh:
+                        json.dump(existing, fh, indent=1)
+                    os.replace(tmp, path)
+                finally:
+                    lock_fh.close()  # releases the flock
             except OSError:
                 pass  # status publication is best-effort
 

@@ -1,0 +1,18 @@
+"""Minimal web app for the kaniko walkthrough (run it from the in-pod
+terminal: `python app.py`)."""
+
+import http.server
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"Built in-cluster by kaniko!\n")
+
+    def log_message(self, *args):
+        pass
+
+
+if __name__ == "__main__":
+    http.server.HTTPServer(("", 8080), Handler).serve_forever()

@@ -1,0 +1,9 @@
+// Minimal node server for the minikube walkthrough.
+const http = require("http");
+
+http
+  .createServer((req, res) => {
+    res.writeHead(200, { "Content-Type": "text/plain" });
+    res.end("Hello from minikube!\n");
+  })
+  .listen(3000, () => console.log("listening on :3000"));

@@ -1,0 +1,20 @@
+"""Tiny HTTP server whose source is BAKED INTO THE IMAGE — editing it
+only takes effect through the rebuild+redeploy loop (no sync)."""
+
+import http.server
+
+MESSAGE = b"Hello from the baked-in image!\n"
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(MESSAGE)
+
+    def log_message(self, *args):
+        pass
+
+
+if __name__ == "__main__":
+    http.server.HTTPServer(("", 8080), Handler).serve_forever()

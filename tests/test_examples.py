@@ -63,6 +63,9 @@ def test_examples_present():
         "jax-resnet-tpu",
         "llama-inference",
         "long-context",
+        "redeploy-instead-of-hot-reload",
+        "kaniko",
+        "minikube",
     } <= names
 
 
@@ -106,3 +109,78 @@ def test_quickstart_kubectl_deploys_on_fake_cluster(tmp_path):
     image = obj["spec"]["template"]["spec"]["containers"][0]["image"]
     assert image == "registry.local/quickstart-kubectl:abc"
     assert fc.get_object("v1", "Service", "quickstart-kubectl", "default")
+
+
+def test_redeploy_example_uses_watch_only_loop(tmp_path, monkeypatch):
+    """examples/redeploy-instead-of-hot-reload: dev with NO sync config —
+    the auto-reload watcher drives a full rebuild+redeploy on change
+    (reference: examples/redeploy-instead-of-hot-reload)."""
+    import shutil
+    import threading
+    import time
+
+    from devspace_tpu.cli.context import Context
+    from devspace_tpu.cli.pipeline import DevLoop
+    from devspace_tpu.utils import log as logutil
+    from devspace_tpu.utils.fsutil import write_file
+
+    example = next(
+        e for e in EXAMPLES if e.endswith("redeploy-instead-of-hot-reload")
+    )
+    proj = tmp_path / "proj"
+    shutil.copytree(example, proj)
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    logutil.set_logger(logutil.DiscardLogger())
+
+    class Args:
+        namespace = None
+        kube_context = None
+        config = None
+        no_sync = False
+        no_portforwarding = True
+        no_terminal = True
+        verbose_sync = False
+        force_build = False
+        force_deploy = False
+
+    ctx = Context(Args())
+    assert not (ctx.config.dev and ctx.config.dev.sync), "example must not sync"
+    loop = DevLoop(ctx, Args())
+    t = threading.Thread(target=loop.run, daemon=True)
+    t.start()
+
+    def wait_for(cond, timeout=30.0, msg="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out: {msg}")
+
+    try:
+        wait_for(loop.services_ready.is_set, msg="services up")
+        assert loop.sync_sessions == []  # no sync in this mode
+        assert loop.watcher is not None  # the watcher IS the loop
+        obj = ctx.backend.get_object(
+            "apps/v1", "Deployment", "redeploy-example", ctx.namespace
+        )
+        tag_before = obj["spec"]["template"]["spec"]["containers"][0]["image"]
+        # editing baked-in source triggers rebuild + redeploy with a new tag
+        write_file(str(proj / "app.py"), "print('changed')\n")
+        wait_for(loop.reload_requested.is_set, msg="watcher fired")
+        wait_for(
+            lambda: loop.services_ready.is_set()
+            and not loop.reload_requested.is_set(),
+            msg="redeployed",
+        )
+        obj = ctx.backend.get_object(
+            "apps/v1", "Deployment", "redeploy-example", ctx.namespace
+        )
+        tag_after = obj["spec"]["template"]["spec"]["containers"][0]["image"]
+        assert tag_after != tag_before, "rebuild must produce a new image tag"
+    finally:
+        loop.stop()
+        loop.stop_services()
+        t.join(timeout=5)

@@ -594,3 +594,52 @@ def test_numeric_type_predicates_match_helm():
     assert render('{{ kindIs "float64" .Values.ratio }}', ctx) == "true"
     assert render('{{ typeIs "string" .Values.port }}', ctx) == "false"
     assert render('{{ typeIs "float64" .Values.name }}', ctx) == "false"
+
+
+def test_semver_compare_real_constraints():
+    """ADVICE r2: semverCompare must actually evaluate constraints (charts
+    pick mutually exclusive manifests by Capabilities.KubeVersion)."""
+    cases = [
+        (">=1.25.0", "v1.27.3", True),
+        (">=1.28.0", "v1.27.3", False),
+        ("<1.27", "v1.27.0", False),
+        ("<1.28", "v1.27.9-gke.100", True),
+        ("~1.27.0", "1.27.5", True),
+        ("~1.27.0", "1.28.0", False),
+        ("^1.2.3", "1.9.9", True),
+        ("^1.2.3", "2.0.0", False),
+        (">=1.21.0-0", "1.27.0", True),
+        ("1.27.x", "1.27.4", True),
+        ("1.26.x", "1.27.4", False),
+        (">=1.25, <1.30", "1.27.0", True),
+        (">=1.25 <1.26", "1.27.0", False),
+        ("1.25 - 1.28", "1.27.0", True),
+        ("<1.20 || >=1.25", "1.27.0", True),
+        ("<1.20 || >=1.28", "1.27.0", False),
+    ]
+    for constraint, version, want in cases:
+        got = render(
+            '{{ semverCompare "%s" "%s" }}' % (constraint, version), {}
+        )
+        assert got == ("true" if want else "false"), (constraint, version)
+
+
+def test_arithmetic_rejects_garbage_and_go_division():
+    """ADVICE r2: non-numeric operands must fail the render (helm
+    diagnoses; silently comparing against 0 takes wrong branches), and
+    div/mod must truncate toward zero like Go."""
+    assert render("{{ div 7 2 }}", {}) == "3"
+    assert render("{{ div -7 2 }}", {}) == "-3"  # python // would give -4
+    assert render("{{ mod -7 2 }}", {}) == "-1"  # python % would give 1
+    assert render("{{ div 7.0 2 }}", {}) == "3.5"
+    assert render("{{ add 1 2 3 }}", {}) == "6"
+    for src in (
+        '{{ gt .Values.missing 0 }}',
+        '{{ lt "abc" 3 }}',
+        '{{ div 1 0 }}',
+        '{{ add 1 "x" }}',
+    ):
+        with pytest.raises(TemplateError):
+            render(src, {"Values": {"missing": None}})
+    # numeric strings still coerce (sprig behavior)
+    assert render('{{ gt "10" 2 }}', {}) == "true"

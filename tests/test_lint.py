@@ -1,0 +1,282 @@
+"""`lint` and `update packages` (VERDICT r2 next #7).
+
+Reference: helm lint renders with default values and schema-checks the
+objects; helm/client.go:169 UpdateRepos refreshes repo indexes before
+installs. Here lint additionally checks the TPU slice invariants at
+render time (analyze's live-pod checks, shifted left)."""
+
+import os
+
+import pytest
+import yaml
+
+from devspace_tpu.cli.main import main
+from devspace_tpu.config.latest import TPUConfig
+from devspace_tpu.deploy.lint import (
+    lint_chart,
+    lint_tpu_consistency,
+    validate_manifests,
+)
+from devspace_tpu.utils import log as logutil
+from devspace_tpu.utils.fsutil import write_file
+
+from test_packages import make_parent_chart, make_repo
+
+
+@pytest.fixture
+def project(tmp_path, monkeypatch):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    write_file(str(proj / "train.py"), "import jax\nprint('step 0')\n")
+    logutil.set_logger(logutil.StdoutLogger())
+    return proj
+
+
+def test_validate_manifests_structural():
+    good = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": "ok-name"},
+        "spec": {"ports": [{"port": 80}]},
+    }
+    assert validate_manifests([good]) == []
+    issues = validate_manifests(
+        [
+            {"kind": "Service", "metadata": {"name": "Bad_Name"}},
+            good,
+            good,  # duplicate
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "d"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "x"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "y"}},
+                        "spec": {"containers": [{"name": "c"}]},
+                    },
+                },
+            },
+        ]
+    )
+    text = "\n".join(issues)
+    assert "missing apiVersion" in text
+    assert "not DNS-1123" in text
+    assert "duplicate object" in text
+    assert "no image" in text
+    assert "selector.matchLabels not matched" in text
+
+
+def test_tpu_consistency_checks():
+    tpu = TPUConfig(accelerator="v5litepod-16", topology="4x4", workers=4)
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": "slice"},
+        "spec": {
+            "replicas": 2,  # != workers
+            "serviceName": "slice",
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "w",
+                            "image": "img",
+                            "env": [
+                                {"name": "TPU_WORKER_ID", "value": "0"},
+                                {
+                                    "name": "TPU_WORKER_HOSTNAMES",
+                                    "value": "a,b",  # 2 != 4 workers
+                                },
+                            ],
+                        }
+                    ]
+                }
+            },
+        },
+    }
+    issues = lint_tpu_consistency([sts], tpu)
+    text = "\n".join(issues)
+    assert "replicas 2 != tpu.workers 4" in text
+    assert "no container requests" in text  # env wired but no google.com/tpu
+    assert "JAX_COORDINATOR_ADDRESS" in text
+    assert "lists 2 host(s), expected 4" in text
+    # topology product mismatch: 4x4=16 chips but 4 workers x 1 chip
+    assert "topology 4x4 has 16" in text
+    # a tpu block with NO slice workload at all is itself a finding
+    assert any(
+        "no rendered workload" in i for i in lint_tpu_consistency([], tpu)
+    )
+
+
+def test_lint_chart_catches_broken_fixture(tmp_path):
+    chart = tmp_path / "broken"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: broken\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("name: ok\n")
+    # object missing kind + container without image
+    (chart / "templates" / "bad.yaml").write_text(
+        "apiVersion: v1\nmetadata:\n  name: ${{ values.name }}\n"
+    )
+    issues = lint_chart(str(chart))
+    # the chart renderer itself refuses kind-less docs; lint surfaces it
+    assert any("no kind" in i for i in issues)
+
+    # a render error IS the lint finding
+    (chart / "templates" / "bad.yaml").write_text(
+        "apiVersion: v1\nkind: X\nmetadata:\n  name: ${{ values.nosuch.deep }}\n"
+    )
+    issues = lint_chart(str(chart))
+    assert issues and "render failed" in issues[0]
+
+
+def test_cli_lint_scaffolded_project_clean_and_catches_breakage(project):
+    assert main(["init"]) == 0
+    assert main(["lint"]) == 0  # the scaffolded chart must lint clean
+    # break the chart: statefulset replicas fixed to 1 while workers=2
+    sts = project / "chart" / "templates" / "statefulset.yaml"
+    if sts.exists():
+        text = sts.read_text().replace("${{ tpu.workers }}", "1")
+        sts.write_text(text)
+        assert main(["lint"]) == 1
+
+
+def test_cli_lint_standalone_chart(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    logutil.set_logger(logutil.StdoutLogger())
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: c\nversion: 0.1.0\n")
+    (chart / "templates" / "x.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: UPPER\n"
+    )
+    assert main(["lint", "--chart", str(chart)]) == 1
+
+
+def test_check_updates_and_upgrade(tmp_path):
+    from devspace_tpu.deploy.packages import (
+        add_package,
+        check_updates,
+        load_requirements,
+        upgrade_package,
+    )
+
+    repo_root = tmp_path / "repo"
+    repo = make_repo(repo_root)  # only 1.0.0 exists
+    chart_dir = make_parent_chart(tmp_path)
+    add_package(chart_dir, repo, "redis")
+    rows = check_updates(chart_dir)
+    assert rows == [
+        {
+            "name": "redis",
+            "current": "1.0.0",
+            "latest": "1.0.0",
+            "repository": repo,
+            "update": False,
+            "error": "",
+        }
+    ]
+
+    # user customizes a value, then the repo publishes 2.0.0
+    values_path = os.path.join(chart_dir, "values.yaml")
+    vals = yaml.safe_load(open(values_path))
+    vals["packages"]["redis"]["tag"] = "custom"
+    yaml.safe_dump(vals, open(values_path, "w"), sort_keys=False)
+    # the repo publishes 2.0.0 (new chart dir + refreshed index)
+    from test_packages import REDIS_TEMPLATE
+
+    chart2 = repo_root / "charts" / "redis-2"
+    (chart2 / "templates").mkdir(parents=True)
+    (chart2 / "chart.yaml").write_text("name: redis\nversion: 2.0.0\n")
+    (chart2 / "values.yaml").write_text("replicas: 2\ntag: '7.2'\n")
+    (chart2 / "templates" / "deployment.yaml").write_text(REDIS_TEMPLATE)
+    (repo_root / "index.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "entries": {
+                    "redis": [
+                        {"version": "2.0.0", "path": "charts/redis-2"},
+                        {"version": "1.0.0", "path": "charts/redis"},
+                    ]
+                }
+            }
+        )
+    )
+    rows = check_updates(chart_dir)
+    assert rows[0]["latest"] == "2.0.0" and rows[0]["update"] is True
+
+    upgrade_package(chart_dir, "redis")
+    deps = load_requirements(chart_dir)
+    assert deps[0]["version"] == "2.0.0"
+    assert "7.2" in (
+        open(os.path.join(chart_dir, "packages", "redis", "values.yaml")).read()
+    )
+    # the user's override survives the upgrade
+    vals = yaml.safe_load(open(values_path))
+    assert vals["packages"]["redis"]["tag"] == "custom"
+
+
+def test_semver_spaced_operator():
+    from devspace_tpu.deploy.gotemplate import _semver_compare
+
+    assert _semver_compare(">= 1.25", "1.27.0") is True
+    assert _semver_compare("> 1.25", "1.27.0") is True
+    assert _semver_compare(">= 1.28", "1.27.0") is False
+
+
+def test_upgrade_tolerates_null_packages_key(tmp_path):
+    """A hand-edited values.yaml with a bare `packages:` (null) key must
+    not crash the upgrade, and a no-op merge must not rewrite the file."""
+    from devspace_tpu.deploy.packages import add_package, upgrade_package
+
+    repo_root = tmp_path / "repo"
+    repo = make_repo(repo_root)
+    chart_dir = make_parent_chart(tmp_path)
+    add_package(chart_dir, repo, "redis")
+    values_path = os.path.join(chart_dir, "values.yaml")
+    with open(values_path, "w") as fh:
+        fh.write("port: 8080\npackages:\n")  # null packages key
+    from test_packages import REDIS_TEMPLATE
+
+    chart2 = repo_root / "charts" / "redis-2"
+    (chart2 / "templates").mkdir(parents=True)
+    (chart2 / "chart.yaml").write_text("name: redis\nversion: 2.0.0\n")
+    (chart2 / "values.yaml").write_text("replicas: 2\ntag: '7.2'\n")
+    (chart2 / "templates" / "deployment.yaml").write_text(REDIS_TEMPLATE)
+    (repo_root / "index.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "entries": {
+                    "redis": [
+                        {"version": "2.0.0", "path": "charts/redis-2"},
+                        {"version": "1.0.0", "path": "charts/redis"},
+                    ]
+                }
+            }
+        )
+    )
+    upgrade_package(chart_dir, "redis")  # must not raise
+    vals = yaml.safe_load(open(values_path))
+    assert vals["packages"]["redis"]["tag"] == "7.2"  # new defaults added
+
+    # second upgrade to the same version is a no-op and must not rewrite
+    before = open(values_path).read()
+    upgrade_package(chart_dir, "redis")
+    assert open(values_path).read() == before
+
+
+def test_cli_update_packages_unknown_name_errors(tmp_path, monkeypatch):
+    from devspace_tpu.cli.main import main as cli_main
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    write_file(str(proj / "app.py"), "print('x')\n")
+    logutil.set_logger(logutil.StdoutLogger())
+    assert cli_main(["init", "--language", "python"]) == 0
+    assert cli_main(["update", "packages", "nosuch"]) == 1

@@ -217,3 +217,17 @@ def test_cli_package_flow(tmp_path, monkeypatch):
     assert main(["add", "package", "ghost", "--repo", repo]) == 1
     # no repo configured
     assert main(["add", "package", "redis"]) == 1
+
+
+def test_archive_url_scheme_restricted(tmp_path):
+    """ADVICE r2: a malicious index can point absolute `urls:` entries at
+    file:///... — only http/https archive URLs may be fetched."""
+    from devspace_tpu.deploy.packages import ChartEntry, PackageError, _fetch_chart
+
+    secret = tmp_path / "secret.tgz"
+    secret.write_bytes(b"x")
+    entry = ChartEntry(
+        name="evil", version="1.0.0", archive=f"file://{secret}"
+    )
+    with pytest.raises(PackageError, match="scheme"):
+        _fetch_chart("http://example.invalid", entry, str(tmp_path / "dest"))
