@@ -25,14 +25,52 @@ def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
 
 
 def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+    """Restore; ``template`` controls structure AND placement. Leaves that
+    are ShapeDtypeStructs WITH a sharding restore to that sharding (the
+    elastic cross-topology path — see :func:`sharded_template`); without
+    shardings Orbax falls back to the layout recorded in the checkpoint."""
     path = os.path.abspath(path)
     if template is not None:
         import orbax.checkpoint as ocp
 
+        # PyTreeRestore alone ignores template shardings; explicit
+        # restore_args are what make cross-topology placement happen
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
         return _checkpointer().restore(
-            path, args=ocp.args.PyTreeRestore(template)
+            path,
+            args=ocp.args.PyTreeRestore(template, restore_args=restore_args),
         )
     return _checkpointer().restore(path)
+
+
+def sharded_template(state: Any, mesh, spec_tree: Any = None) -> Any:
+    """Abstract restore template placing every leaf on ``mesh``.
+
+    THE elastic-restore mechanism: a checkpoint saved on one mesh shape
+    restores onto a DIFFERENT one (8 -> 4 devices after losing a slice,
+    4 -> 8 after scaling up) by describing where each array should live
+    on the new mesh — Orbax reads the full logical array and shards it
+    per the template, instead of blindly reproducing the saved layout
+    (which references devices that no longer exist).
+
+    ``state`` supplies structure/shapes/dtypes (concrete arrays or
+    ShapeDtypeStructs, e.g. from ``jax.eval_shape``); ``spec_tree`` is a
+    leaf-for-leaf matching pytree of PartitionSpecs — use ``P()`` (not
+    ``None``) for replicated leaves, since None is an empty pytree node
+    and would break the structure match. Passing ``spec_tree=None``
+    replicates everything. Build optimizer-state specs with
+    ``trainer.opt_state_partition_spec``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def leaf(x, spec):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    if spec_tree is None:
+        return jax.tree_util.tree_map(lambda x: leaf(x, P()), state)
+    return jax.tree_util.tree_map(leaf, state, spec_tree)
 
 
 def list_step_dirs(root: str) -> list[tuple[int, str]]:

@@ -13,6 +13,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the image's sitecustomize pre-imports jax and freezes the platform
+    # default at interpreter startup — the env var alone is too late
+    # (same workaround as tests/conftest.py and bench.py)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,6 +37,36 @@ CFG = tfm.TransformerConfig(
 )
 N_REQ = 8
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", 64))
+# which phases to run (comma list); smoke runs can pick one
+PHASES = set(
+    os.environ.get("BENCH_PHASES", "serial,engine,admission,pressure").split(",")
+)
+
+
+def _gap_stats(gaps: list) -> dict:
+    """p50/p95/max (ms) of inter-token gaps — one implementation for the
+    admission and pressure phases."""
+    gaps = sorted(gaps)
+    if not gaps:
+        return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+    return {
+        "p50": round(gaps[len(gaps) // 2] * 1000, 1),
+        "p95": round(gaps[min(int(len(gaps) * 0.95), len(gaps) - 1)] * 1000, 1),
+        "max": round(gaps[-1] * 1000, 1),
+    }
+
+
+def _stream_gaps(handle, timeout: float, on_token=None) -> list:
+    """Consume a streaming request, timing gaps between tokens."""
+    gaps, last = [], None
+    for i, _ in enumerate(handle.stream(timeout=timeout)):
+        now = time.time()
+        if last is not None:
+            gaps.append(now - last)
+        last = now
+        if on_token is not None:
+            on_token(i)
+    return gaps
 
 
 def main():
@@ -44,115 +81,213 @@ def main():
     total_new = N_REQ * NEW_TOKENS
 
     # serial: one generate per request (compile once on a warmup)
-    warm = jnp.asarray([prompts[0]], jnp.int32)
-    jax.block_until_ready(tfm.generate(params, warm, CFG, max_new_tokens=NEW_TOKENS))
-    t0 = time.time()
-    for p in prompts:
-        out = tfm.generate(
-            params, jnp.asarray([p], jnp.int32), CFG, max_new_tokens=NEW_TOKENS
+    serial_s = None
+    if "serial" in PHASES:
+        warm = jnp.asarray([prompts[0]], jnp.int32)
+        jax.block_until_ready(tfm.generate(params, warm, CFG, max_new_tokens=NEW_TOKENS))
+        t0 = time.time()
+        for p in prompts:
+            out = tfm.generate(
+                params, jnp.asarray([p], jnp.int32), CFG, max_new_tokens=NEW_TOKENS
+            )
+        jax.block_until_ready(out)
+        serial_s = time.time() - t0
+        print(
+            f"[inf-bench] serial generate: {total_new / serial_s:.1f} tok/s "
+            f"({serial_s:.2f}s; per-request prompt recompiles included)",
+            file=sys.stderr,
         )
-    jax.block_until_ready(out)
-    serial_s = time.time() - t0
-    print(
-        f"[inf-bench] serial generate: {total_new / serial_s:.1f} tok/s "
-        f"({serial_s:.2f}s; per-request prompt recompiles included)",
-        file=sys.stderr,
-    )
 
     # engine: all 8 in flight
-    engine = InferenceEngine(
-        params,
-        CFG,
-        max_slots=N_REQ,
-        max_len=256,
-        chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
-    ).start()
-    try:
-        # warmup/compile wave at FULL length — short warmups would leave
-        # the larger chunk kernels to compile inside the timed window
-        for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
-            h.result(timeout=600)
-        t0 = time.time()
-        handles = [engine.submit(p, NEW_TOKENS) for p in prompts]
-        for h in handles:
-            h.result(timeout=600)
-        engine_s = time.time() - t0
-    finally:
-        engine.stop()
-    print(
-        f"[inf-bench] continuous batching: {total_new / engine_s:.1f} tok/s "
-        f"({engine_s:.2f}s) -> {serial_s / engine_s:.2f}x serial",
-        file=sys.stderr,
-    )
+    engine_s = None
+    if "engine" in PHASES:
+        engine = InferenceEngine(
+            params,
+            CFG,
+            max_slots=N_REQ,
+            max_len=256,
+            chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+        ).start()
+        try:
+            # warmup/compile wave at FULL length — short warmups would leave
+            # the larger chunk kernels to compile inside the timed window
+            for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
+                h.result(timeout=600)
+            t0 = time.time()
+            handles = [engine.submit(p, NEW_TOKENS) for p in prompts]
+            for h in handles:
+                h.result(timeout=600)
+            engine_s = time.time() - t0
+        finally:
+            engine.stop()
+        ratio = f" -> {serial_s / engine_s:.2f}x serial" if serial_s else ""
+        print(
+            f"[inf-bench] continuous batching: {total_new / engine_s:.1f} tok/s "
+            f"({engine_s:.2f}s){ratio}",
+            file=sys.stderr,
+        )
 
     # inter-token latency under admission load (VERDICT r1 next #3): a
     # streaming request's token gaps while a LONG prompt is admitted
     # mid-stream — chunked prefill keeps the gap bounded by the chunk
     # budget, not the whole prompt.
-    engine = InferenceEngine(
-        params,
-        CFG,
-        max_slots=4,
-        max_len=512,
-        chunk_max=4,
-        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
-    ).start()
-    try:
-        warm = engine.submit(prompts[0], 16)
-        warm.result(timeout=600)  # compile decode + small prefill buckets
-        long_prompt = list(rng.integers(1, 1000, size=384))
-        warm2 = engine.submit(long_prompt[:256], 2)  # compile big buckets
-        warm2.result(timeout=600)
+    admission_stats = None
+    if "admission" in PHASES:
+        engine = InferenceEngine(
+            params,
+            CFG,
+            max_slots=4,
+            max_len=512,
+            chunk_max=4,
+            prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
+        ).start()
+        try:
+            warm = engine.submit(prompts[0], 16)
+            warm.result(timeout=600)  # compile decode + small prefill buckets
+            long_prompt = list(rng.integers(1, 1000, size=384))
+            warm2 = engine.submit(long_prompt[:256], 2)  # compile big buckets
+            warm2.result(timeout=600)
 
-        stream_req = engine.submit(prompts[1], 96)
-        gaps, last = [], None
-        admitted = False
-        for _ in stream_req.stream(timeout=600):
-            now = time.time()
-            if last is not None:
-                gaps.append(now - last)
-            last = now
-            if not admitted and len(gaps) >= 8:
-                engine.submit(long_prompt, 8)  # admit mid-stream
-                admitted = True
-        gaps_during = sorted(gaps[8:]) or [0.0]
-        p50 = gaps_during[len(gaps_during) // 2]
-        p95 = gaps_during[int(len(gaps_during) * 0.95) - 1]
-        mx = gaps_during[-1]
-    finally:
-        engine.stop()
-    print(
-        f"[inf-bench] inter-token gap during long-prompt admission: "
-        f"p50 {p50*1000:.1f}ms p95 {p95*1000:.1f}ms max {mx*1000:.1f}ms",
-        file=sys.stderr,
-    )
+            stream_req = engine.submit(prompts[1], 96)
+            admitted = []
+
+            def admit(i):
+                if not admitted and i >= 8:
+                    engine.submit(long_prompt, 8)  # admit mid-stream
+                    admitted.append(True)
+
+            gaps = _stream_gaps(stream_req, timeout=600, on_token=admit)
+            admission_stats = _gap_stats(gaps[8:])
+        finally:
+            engine.stop()
+        print(
+            f"[inf-bench] inter-token gap during long-prompt admission: "
+            f"p50 {admission_stats['p50']}ms p95 {admission_stats['p95']}ms "
+            f"max {admission_stats['max']}ms",
+            file=sys.stderr,
+        )
+
+    pressure = None
+    if "pressure" in PHASES:
+        pressure = _pressure_phase(params, rng)
 
     import json
 
-    print(
-        json.dumps(
-            {
-                "metric": "serving_continuous_batching_tok_per_sec",
-                "value": round(total_new / engine_s, 1),
-                "unit": "tok/s",
-                "vs_serial_generate": round(serial_s / engine_s, 2),
-                "serial_tok_per_sec": round(total_new / serial_s, 1),
-                "intertoken_during_admission_ms": {
-                    "p50": round(p50 * 1000, 1),
-                    "p95": round(p95 * 1000, 1),
-                    "max": round(mx * 1000, 1),
-                },
-                "config": {
-                    "dim": CFG.dim,
-                    "layers": CFG.n_layers,
-                    "new_tokens": NEW_TOKENS,
-                    "requests": N_REQ,
-                    "prefill_chunk": int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
-                    "paged_kv_block": 64,
-                },
-            }
+    result = {
+        "metric": "serving_continuous_batching_tok_per_sec",
+        "value": round(total_new / engine_s, 1) if engine_s else None,
+        "unit": "tok/s",
+        "vs_serial_generate": round(serial_s / engine_s, 2)
+        if serial_s and engine_s
+        else None,
+        "serial_tok_per_sec": round(total_new / serial_s, 1)
+        if serial_s
+        else None,
+        "intertoken_during_admission_ms": admission_stats,
+        "pressure": pressure,
+        "config": {
+            "dim": CFG.dim,
+            "layers": CFG.n_layers,
+            "new_tokens": NEW_TOKENS,
+            "requests": N_REQ,
+            "prefill_chunk": int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
+            "paged_kv_block": 64,
+        },
+    }
+    print(json.dumps(result))
+
+
+def _pressure_phase(params, rng) -> dict:
+    # KV memory pressure (VERDICT r2 next #4): a pool HALF the aggregate
+    # demand, so preemption/recompute must fire DURING the measured run —
+    # the paged-KV engine's headline feature under its design condition,
+    # not just a functional CPU test. One request streams so inter-token
+    # gaps capture the preemption stalls.
+    p_slots = int(os.environ.get("BENCH_PRESSURE_SLOTS", 8))
+    p_len = int(os.environ.get("BENCH_PRESSURE_LEN", 512))
+    p_new = int(os.environ.get("BENCH_PRESSURE_NEW", p_len - 64))
+    p_block = 64
+    p_prompt = 48
+    if p_new < 1 or p_prompt + p_new > p_len:
+        raise SystemExit(
+            f"[inf-bench] BENCH_PRESSURE_NEW={p_new} invalid: need "
+            f"1 <= new and {p_prompt}+new <= BENCH_PRESSURE_LEN={p_len}"
         )
+    blocks_per_slot = p_len // p_block
+    # half of full demand (+1 scratch block 0)
+    p_blocks = 1 + (p_slots * blocks_per_slot) // 2
+    if p_blocks < 1 + blocks_per_slot:
+        raise SystemExit(
+            f"[inf-bench] BENCH_PRESSURE_SLOTS={p_slots} too small: the "
+            f"half-demand pool ({p_blocks} blocks) cannot hold one max_len "
+            f"sequence ({blocks_per_slot} blocks); use >= 3 slots"
+        )
+    # ACTUAL aggregate demand of the submitted requests (not max_len):
+    # the honest oversubscription figure for the artifact
+    demand_blocks = -(-(p_prompt + p_new) // p_block) * p_slots
+    usable_blocks = p_blocks - 1
+    oversubscription = demand_blocks / usable_blocks
+    if oversubscription <= 1.0:
+        print(
+            f"[inf-bench] WARNING: pressure config demands {demand_blocks} "
+            f"blocks <= pool {usable_blocks} — no oversubscription; "
+            f"raise BENCH_PRESSURE_NEW",
+            file=sys.stderr,
+        )
+    engine = InferenceEngine(
+        params,
+        CFG,
+        max_slots=p_slots,
+        max_len=p_len,
+        chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+        block_size=p_block,
+        n_blocks=p_blocks,
+    ).start()
+    try:
+        # compile wave: short generations, pool barely touched
+        warm_prompts = [
+            list(rng.integers(1, 1000, size=16)) for _ in range(p_slots)
+        ]
+        for h in [engine.submit(p, 4) for p in warm_prompts]:
+            h.result(timeout=600)
+        pre_before = engine.requests_preempted
+        t0 = time.time()
+        stream_h = engine.submit(list(rng.integers(1, 1000, size=p_prompt)), p_new)
+        rest = [
+            engine.submit(list(rng.integers(1, 1000, size=p_prompt)), p_new)
+            for _ in range(p_slots - 1)
+        ]
+        pgaps = _stream_gaps(stream_h, timeout=1800)
+        for h in rest:
+            h.result(timeout=1800)
+        pressure_s = time.time() - t0
+        preemptions = engine.requests_preempted - pre_before
+    finally:
+        engine.stop()
+    pressure_tok = p_slots * p_new
+    stats = _gap_stats(pgaps)
+    print(
+        f"[inf-bench] under {oversubscription:.2f}x KV oversubscription: "
+        f"{pressure_tok / pressure_s:.1f} tok/s, {preemptions} preemption(s), "
+        f"inter-token p50 {stats['p50']}ms p95 {stats['p95']}ms",
+        file=sys.stderr,
     )
+    if preemptions == 0:
+        print(
+            "[inf-bench] WARNING: pressure phase fired no preemptions — "
+            "sizes too small for the pool; raise BENCH_PRESSURE_NEW",
+            file=sys.stderr,
+        )
+    return {
+        "tok_per_sec": round(pressure_tok / pressure_s, 1),
+        "preemptions": preemptions,
+        "kv_oversubscription": round(oversubscription, 2),
+        "requests": p_slots,
+        "new_tokens_each": p_new,
+        "pool_blocks": p_blocks,
+        "demand_blocks": demand_blocks,
+        "intertoken_ms": stats,
+    }
 
 
 if __name__ == "__main__":

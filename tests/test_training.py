@@ -186,3 +186,87 @@ def test_async_checkpoint_restore_or_init_and_close(tmp_path):
         assert step == 5
         assert float(restored["w"][0]) == 1.0
     mgr.close()  # idempotent after context exit
+
+
+def test_elastic_restore_across_mesh_shapes(tmp_path):
+    """VERDICT r2 next #8: a checkpoint saved on an 8-device
+    {data:4, model:2} mesh restores onto a 4-device {data:2, model:2}
+    mesh via a sharded template, and training continues to EXACTLY the
+    loss the uninterrupted 8-device run reaches (data-parallel math is
+    global-batch math, so the mesh shape must not matter)."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.parallel.mesh import create_mesh
+    from devspace_tpu.training.checkpoint import sharded_template
+    from devspace_tpu.training.trainer import (
+        make_lm_train_step,
+        opt_state_partition_spec,
+    )
+
+    cfg = dataclasses.replace(tfm.TINY, dtype=jnp.float32)
+    spec = tfm.param_partition_spec(cfg, model_axis="model")
+    opt = optax.adam(1e-2)
+    tokens_np = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab_size)
+    )
+
+    def place(mesh, params):
+        return jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params,
+            spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    mesh8 = create_mesh({"data": 4, "model": 2})
+    params8 = place(mesh8, tfm.init_params(cfg, jax.random.PRNGKey(0)))
+    state = {
+        "params": params8,
+        "opt_state": jax.device_put(
+            opt.init(params8), NamedSharding(mesh8, P())
+        ),
+        "step": jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh8, P())),
+    }
+    step8 = make_lm_train_step(
+        tfm.forward, cfg, opt, mesh=mesh8, data_axis="data", param_spec=spec,
+        donate=False,
+    )
+    tokens8 = jax.device_put(tokens_np, NamedSharding(mesh8, P("data")))
+    state, _ = step8(state, tokens8)
+    save_checkpoint(str(tmp_path / "elastic"), state)
+    _, l2_ref = step8(state, tokens8)
+
+    # ...the slice shrinks: restore the same checkpoint on HALF the devices
+    mesh4 = create_mesh({"data": 2, "model": 2}, devices=jax.devices()[:4])
+    abstract = jax.eval_shape(
+        lambda: {
+            "params": tfm.init_params(cfg, jax.random.PRNGKey(0)),
+            "opt_state": opt.init(tfm.init_params(cfg, jax.random.PRNGKey(0))),
+            "step": jnp.zeros((), jnp.int32),
+        }
+    )
+    template = {
+        "params": sharded_template(abstract["params"], mesh4, spec),
+        "opt_state": sharded_template(
+            abstract["opt_state"],
+            mesh4,
+            opt_state_partition_spec(abstract["opt_state"], spec),
+        ),
+        "step": sharded_template(abstract["step"], mesh4),
+    }
+    state4 = restore_checkpoint(str(tmp_path / "elastic"), template)
+    # restored leaves actually live on the new mesh with the right layout
+    wq = state4["params"]["layers"][0]["wq"]
+    assert wq.sharding.mesh.devices.size == 4
+    assert wq.sharding.spec == P(None, "model")
+
+    step4 = make_lm_train_step(
+        tfm.forward, cfg, opt, mesh=mesh4, data_axis="data", param_spec=spec,
+        donate=False,
+    )
+    tokens4 = jax.device_put(tokens_np, NamedSharding(mesh4, P("data")))
+    _, l2 = step4(state4, tokens4)
+    assert abs(float(l2) - float(l2_ref)) < 1e-5
