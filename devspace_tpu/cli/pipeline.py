@@ -95,6 +95,7 @@ class DevLoop:
         self.watcher: Optional[GlobWatcher] = None
         self.logmux: Optional[svc.LogMux] = None
         self.reload_requested = threading.Event()
+        self.reload_count = 0  # cumulative reloads (event is cleared fast)
         self.stop_requested = threading.Event()
         self.services_ready = threading.Event()
 
@@ -127,6 +128,7 @@ class DevLoop:
 
     def _on_reload(self, changed: list[str]) -> None:
         self.log.info("[dev] change in %s — redeploying", ", ".join(changed[:3]))
+        self.reload_count += 1
         self.reload_requested.set()
 
     def stop_services(self) -> None:

@@ -379,13 +379,15 @@ def decode_tokens_paged(
     cfg: TransformerConfig,
 ) -> tuple[jax.Array, dict]:
     """``decode_tokens`` over a paged pool: identical math, but K/V reads
-    gather each slot's blocks and the new token's K/V scatters into
+    come straight from each slot's blocks (Pallas paged-attention kernel
+    on TPU — no gather materialization; jnp gather reference elsewhere,
+    ops/paged_attention.py) and the new token's K/V scatters into
     (table[pos // bs], pos % bs)."""
+    from ..ops.paged_attention import paged_decode_attention
+
     b = tokens.shape[0]
     hd = cfg.head_dim
-    n_rep = cfg.n_heads // cfg.n_kv_heads
     bs = pool["k"].shape[2]
-    t_alloc = tables.shape[1] * bs
     cos, sin = rope_frequencies(cfg, positions)
 
     def rope1(x):
@@ -394,6 +396,7 @@ def decode_tokens_paged(
     batch_idx = jnp.arange(b)
     blk = tables[batch_idx, positions // bs]  # [B] pool block per sequence
     off = positions % bs
+    lengths = positions + 1  # valid cache entries incl. the new token
     h = params["embed"][tokens][:, None, :]
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
@@ -407,17 +410,9 @@ def decode_tokens_paged(
         v_pool = pool["v"][li].at[blk, off].set(v[:, 0])
         new_k.append(k_pool)
         new_v.append(v_pool)
-        keys = repeat_kv(_gather_pages(k_pool, tables), n_rep)
-        vals = repeat_kv(_gather_pages(v_pool, tables), n_rep)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(hd).astype(jnp.float32)
-        mask = (jnp.arange(t_alloc)[None, :] <= positions[:, None])[
-            :, None, None, :
-        ]
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vals).astype(h.dtype)
+        ctx = paged_decode_attention(
+            q[:, 0], k_pool, v_pool, tables, lengths
+        )  # [B, H, D]
         h = h + (ctx.reshape(b, 1, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
         gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])

@@ -1,0 +1,158 @@
+"""Paged-attention decode kernel (Pallas TPU) + jnp reference.
+
+The paged-KV engine (inference/engine.py) stores K/V in a block pool
+with per-slot block tables (vLLM layout). The jnp decode path
+materializes each slot's logical cache view with ``pool[tables]`` — an
+HBM gather of the ENTIRE allocated cache every step, per layer, even
+though attention then reads each value exactly once. This kernel removes
+that copy: the grid walks each slot's table and streams K/V blocks
+straight from the pool into VMEM (block indices arrive via scalar
+prefetch, so the DMA pipeline knows the addresses ahead of the compute),
+with a running online-softmax over blocks. HBM traffic drops from
+2x(gather + read) to 1x read — decode attention is bandwidth-bound, so
+that is the whole game.
+
+GQA: queries arrive grouped per KV head ([B, Hkv, n_rep, D]); each grid
+step attends n_rep query heads against one KV head's block, so grouped
+K/V are never materialized to full head count either (the jnp path's
+``repeat_kv`` copy).
+
+Reference: decode math identical to models/transformer.py
+decode_tokens_paged's inline gather version; tested against it in
+interpret mode (tests/test_models_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import interpret_mode, use_pallas
+
+NEG_INF = -1e30
+
+
+def paged_decode_reference(q, pool_k, pool_v, tables, lengths):
+    """Gather-based reference. q [B, H, D]; pool_k/v [N, bs, Hkv, D];
+    tables [B, MB] int32; lengths [B] int32 (valid cache entries per
+    slot, INCLUDING the current token) -> ctx [B, H, D] (q dtype)."""
+    b, h, d = q.shape
+    n, bs, hkv, _ = pool_k.shape
+    mb = tables.shape[1]
+    n_rep = h // hkv
+    t_alloc = mb * bs
+    keys = pool_k[tables].reshape(b, t_alloc, hkv, d)
+    vals = pool_v[tables].reshape(b, t_alloc, hkv, d)
+    if n_rep > 1:
+        keys = jnp.repeat(keys, n_rep, axis=2)
+        vals = jnp.repeat(vals, n_rep, axis=2)
+    scores = jnp.einsum(
+        "bhd,bkhd->bhk", q, keys, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(d).astype(jnp.float32)
+    mask = (jnp.arange(t_alloc)[None, :] < lengths[:, None])[:, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs, vals).astype(q.dtype)
+
+
+def _kernel(
+    tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, block_size,
+):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+
+    # skip blocks wholly past this slot's cache length (dead slots skip
+    # everything — their output is zeroed in _finish)
+    @pl.when(j * block_size < length)
+    def _step():
+        q = q_ref[0, 0]  # [n_rep, D]
+        k = k_ref[0, :, 0, :]  # [bs, D]
+        v = v_ref[0, :, 0, :]
+        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [n_rep, bs]
+        pos = j * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_scr[:]
+        l = jnp.where(l == 0.0, 1.0, l)  # dead slot: all-masked
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, pool_k, pool_v, tables, lengths):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    n, bs, hkv, _ = pool_k.shape
+    mb = tables.shape[1]
+    n_rep = h // hkv
+    q4 = q.reshape(b, hkv, n_rep, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lengths
+        grid=(b, hkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_rep, d), lambda bi, hi, ji, t, L: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d), lambda bi, hi, ji, t, L: (t[bi, ji], 0, hi, 0)),
+            pl.BlockSpec((1, bs, 1, d), lambda bi, hi, ji, t, L: (t[bi, ji], 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, n_rep, d), lambda bi, hi, ji, t, L: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_rep, 1), jnp.float32),
+            pltpu.VMEM((n_rep, 1), jnp.float32),
+            pltpu.VMEM((n_rep, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret_mode(),
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), q4, pool_k, pool_v)
+    return out.reshape(b, h, d)
+
+
+def paged_decode_attention(q, pool_k, pool_v, tables, lengths):
+    """One decode step of paged attention: q [B, H, D] against each
+    slot's pooled cache -> ctx [B, H, D]. Pallas on TPU (no gather
+    materialization), jnp reference elsewhere."""
+    if use_pallas():
+        return _paged_decode_pallas(q, pool_k, pool_v, tables, lengths)
+    return paged_decode_reference(q, pool_k, pool_v, tables, lengths)

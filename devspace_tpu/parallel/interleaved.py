@@ -1,0 +1,209 @@
+"""Interleaved (virtual-stage) 1F1B pipeline schedule.
+
+Megatron-LM's interleaved schedule (Narayanan et al. 2021, "Efficient
+large-scale language model training on GPU clusters"): each of the S
+pipeline devices holds V model CHUNKS instead of one contiguous stage —
+virtual stage p (of P = S*V) lives on device p % S, so every
+stage-to-stage hop is still a ring +1 ppermute, and the pipeline
+fill/drain bubble shrinks ~V-fold because a device starts computing its
+first chunk after 1/V of the old fill time.
+
+TPU-first formulation: rather than per-rank imperative op lists (the
+GPU-framework shape of this schedule), the whole schedule is compiled to
+STATIC per-tick tables (numpy [T, S]: op, chunk, microbatch, ring slot,
+receive routing). The train step is then ONE lax.scan whose body indexes
+the tables with the device's stage id — no data-dependent control flow,
+exactly like the non-interleaved schedule in pipeline.py, just
+table-driven instead of closed-form.
+
+The builder is a greedy earliest-tick list scheduler under the real
+constraints (F needs the upstream activation a tick earlier, B needs the
+downstream grad a tick earlier, one op per device per tick, in-order
+microbatches per virtual stage), with backward-first priority — running
+B as early as possible is what bounds in-flight activations (1F1B's
+memory property) — and Megatron's chunk-cycling forward order (groups of
+S microbatches per chunk) which is what realizes the V-fold bubble
+shrink. Buffer depths (activation stash per chunk, in-flight hops per
+edge) are derived from the schedule afterwards and become static array
+sizes in the executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+OP_IDLE, OP_F, OP_B = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class InterleavedSchedule:
+    n_stages: int  # S devices
+    n_chunks: int  # V chunks per device
+    n_micro: int  # M microbatches
+    total_ticks: int
+    ring_depth: int  # max in-flight microbatches per (device, chunk)
+    in_depth: int  # received-activation/grad buffer slots per chunk
+    # all [T, S] int32 tables
+    op: np.ndarray  # OP_IDLE / OP_F / OP_B
+    chunk: np.ndarray  # local chunk the op runs on
+    mb: np.ndarray  # microbatch index of the op
+    slot: np.ndarray  # activation-ring slot (F stores, B loads)
+    recv_f_chunk: np.ndarray  # chunk to store the arriving fwd act (-1 none)
+    recv_f_slot: np.ndarray
+    recv_b_chunk: np.ndarray  # chunk to store the arriving grad (-1 none)
+    recv_b_slot: np.ndarray
+
+    @property
+    def bubble_fraction(self) -> float:
+        busy = 2 * self.n_micro * self.n_chunks  # per device
+        return 1.0 - busy / (self.total_ticks or 1)
+
+
+def build_interleaved_schedule(
+    n_stages: int, n_chunks: int, n_micro: int
+) -> InterleavedSchedule:
+    S, V, M = n_stages, n_chunks, n_micro
+    P = S * V
+    f_done: dict[tuple[int, int], int] = {}  # (p, m) -> tick
+    b_done: dict[tuple[int, int], int] = {}
+
+    def f_ready(p: int, m: int, tau: int) -> bool:
+        if (p, m) in f_done:
+            return False
+        if m > 0 and (p, m - 1) not in f_done:
+            return False  # in-order per stage (buffer slots rely on it)
+        if p > 0 and f_done.get((p - 1, m), tau) >= tau:
+            return False
+        return True
+
+    def b_ready(p: int, m: int, tau: int) -> bool:
+        if (p, m) in b_done:
+            return False
+        if m > 0 and (p, m - 1) not in b_done:
+            return False
+        if p == P - 1:
+            if f_done.get((p, m), tau) >= tau:
+                return False
+        elif b_done.get((p + 1, m), tau) >= tau:
+            return False
+        return True
+
+    ops: list[list[tuple[int, int, int]]] = []  # per tick: [(op,p,m)] per dev
+    tau = 0
+    while len(f_done) + len(b_done) < 2 * P * M:
+        tick_ops: list[tuple[int, int, int]] = [(OP_IDLE, 0, 0)] * S
+        scheduled = False
+        for s in range(S):
+            best = None
+            # backward first (1F1B memory bound), earliest microbatch,
+            # deepest chunk (drain the far end before refilling)
+            b_cands = []
+            for v in range(V):
+                p = v * S + s
+                for m in range(M):
+                    if b_ready(p, m, tau):
+                        b_cands.append(((m, -v), (OP_B, p, m)))
+                        break
+            if b_cands:
+                best = min(b_cands)[1]
+            else:
+                # Megatron chunk-cycling forward order: groups of S
+                # microbatches per chunk, cycling chunks between groups
+                f_cands = []
+                for v in range(V):
+                    p = v * S + s
+                    for m in range(M):
+                        if f_ready(p, m, tau):
+                            f_cands.append(((m // S, v, m), (OP_F, p, m)))
+                            break
+                if f_cands:
+                    best = min(f_cands)[1]
+            if best is not None:
+                tick_ops[s] = best
+                scheduled = True
+        # commit AFTER selection: readiness used `>= tau`, so ops chosen
+        # this tick cannot feed each other within the tick
+        for s in range(S):
+            op, p, m = tick_ops[s]
+            if op == OP_F:
+                f_done[(p, m)] = tau
+            elif op == OP_B:
+                b_done[(p, m)] = tau
+        if not scheduled:
+            raise RuntimeError(
+                f"interleaved schedule deadlocked at tick {tau} "
+                f"(S={S}, V={V}, M={M})"
+            )
+        ops.append(tick_ops)
+        tau += 1
+
+    total = len(ops)
+    # activation-ring depth: max in-flight (F done, B pending) per stage
+    ring_depth = 1
+    for p in range(P):
+        events = []
+        for m in range(M):
+            events.append((f_done[(p, m)], 1))
+            events.append((b_done[(p, m)], -1))
+        events.sort()
+        cur = 0
+        for _, delta in events:
+            cur += delta
+            ring_depth = max(ring_depth, cur)
+    # received-buffer depth: max outstanding per forward edge (produced
+    # at p, not yet consumed at p+1) and per backward edge
+    in_depth = 1
+    for p in range(P - 1):
+        events = []
+        for m in range(M):
+            events.append((f_done[(p, m)], 1))
+            events.append((f_done[(p + 1, m)], -1))
+            events.append((b_done[(p + 1, m)], 1))
+            events.append((b_done[(p, m)], -1))
+        events.sort()
+        cur = 0
+        for _, delta in events:
+            cur += delta
+            in_depth = max(in_depth, cur)
+
+    op_t = np.zeros((total, S), np.int32)
+    chunk_t = np.zeros((total, S), np.int32)
+    mb_t = np.zeros((total, S), np.int32)
+    slot_t = np.zeros((total, S), np.int32)
+    recv_f_c = np.full((total, S), -1, np.int32)
+    recv_f_s = np.zeros((total, S), np.int32)
+    recv_b_c = np.full((total, S), -1, np.int32)
+    recv_b_s = np.zeros((total, S), np.int32)
+    for tau, tick_ops in enumerate(ops):
+        for s in range(S):
+            op, p, m = tick_ops[s]
+            op_t[tau, s] = op
+            if op == OP_IDLE:
+                continue
+            chunk_t[tau, s] = p // S
+            mb_t[tau, s] = m
+            slot_t[tau, s] = m % ring_depth
+            if op == OP_F and p + 1 < P and tau + 1 < total:
+                recv_f_c[tau + 1, (s + 1) % S] = (p + 1) // S
+                recv_f_s[tau + 1, (s + 1) % S] = m % in_depth
+            if op == OP_B and p > 0 and tau + 1 < total:
+                recv_b_c[tau + 1, (s - 1) % S] = (p - 1) // S
+                recv_b_s[tau + 1, (s - 1) % S] = m % in_depth
+    return InterleavedSchedule(
+        n_stages=S,
+        n_chunks=V,
+        n_micro=M,
+        total_ticks=total,
+        ring_depth=ring_depth,
+        in_depth=in_depth,
+        op=op_t,
+        chunk=chunk_t,
+        mb=mb_t,
+        slot=slot_t,
+        recv_f_chunk=recv_f_c,
+        recv_f_slot=recv_f_s,
+        recv_b_chunk=recv_b_c,
+        recv_b_slot=recv_b_s,
+    )

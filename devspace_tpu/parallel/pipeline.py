@@ -210,6 +210,62 @@ def pipeline_param_specs(axis: str = "pipe", tp_axis: str = None) -> dict:
     }
 
 
+def _tp_layer_setup(cfg, tp: int, tp_axis):
+    """Per-shard cfg + layer_apply hooks for Megatron-TP stages — the ONE
+    place the tensor-parallel boundary wiring lives (shared by the plain
+    and interleaved 1F1B schedules)."""
+    if tp_axis is None:
+        return cfg, {}
+    import dataclasses
+
+    from .tensor_parallel import copy_fwd_psum_bwd, psum_fwd_copy_bwd
+
+    local_cfg = dataclasses.replace(
+        cfg,
+        n_heads=cfg.n_heads // tp,
+        n_kv_heads=cfg.n_kv_heads // tp,
+        ffn_dim=cfg.ffn_dim // tp,
+        head_dim_override=cfg.head_dim,
+    )
+    layer_kwargs = dict(
+        pre_block=lambda x: copy_fwd_psum_bwd(x, tp_axis),
+        post_block=lambda x: psum_fwd_copy_bwd(x, tp_axis),
+    )
+    return local_cfg, layer_kwargs
+
+
+def _check_tp_divisibility(cfg, tp: int) -> None:
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp:
+        raise ValueError(
+            f"heads/kv/ffn ({cfg.n_heads}/{cfg.n_kv_heads}/{cfg.ffn_dim}) "
+            f"not divisible by tp={tp}"
+        )
+
+
+def _reduce_pipeline_grads(
+    loss_sum, g_embed, g_head, g_stages, axis, data_axis, m_total
+):
+    """Shared grad epilogue: loss lives on the last stage, embed grad on
+    stage 0, head grads on the last stage — psum over pipe replicates the
+    totals; stage grads stay pipe-sharded; everything pmeans over data."""
+    loss = jax.lax.psum(loss_sum, axis) / m_total
+    g_embed = jax.lax.psum(g_embed, axis) / m_total
+    g_head = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis) / m_total, g_head
+    )
+    g_stages = jax.tree_util.tree_map(lambda g: g / m_total, g_stages)
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+        g_embed = jax.lax.pmean(g_embed, data_axis)
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, data_axis), g_head
+        )
+        g_stages = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, data_axis), g_stages
+        )
+    return loss, g_embed, g_head, g_stages
+
+
 def pipeline_lm_loss_and_grads(
     mesh: Mesh,
     cfg,
@@ -238,16 +294,12 @@ def pipeline_lm_loss_and_grads(
         rope_frequencies,
     )
     from ..ops.losses import fused_cross_entropy
-    from .tensor_parallel import copy_fwd_psum_bwd, psum_fwd_copy_bwd
 
     n_stages = mesh.shape[axis]
     m_total = n_microbatches
     tp = mesh.shape[tp_axis] if tp_axis else 1
-    if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp:
-        raise ValueError(
-            f"heads/kv/ffn ({cfg.n_heads}/{cfg.n_kv_heads}/{cfg.ffn_dim}) "
-            f"not divisible by tp={tp}"
-        )
+    _check_tp_divisibility(cfg, tp)
+    local_cfg, layer_kwargs = _tp_layer_setup(cfg, tp, tp_axis)
 
     def local_fn(stage_params, tokens):
         stage = jax.lax.axis_index(axis)
@@ -265,29 +317,10 @@ def pipeline_lm_loss_and_grads(
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
         # Tensor parallelism reuses layer_apply (the single source of
-        # truth for the layer math) with a per-shard cfg: local head/ffn
-        # counts plus the true head size, and the Megatron f/g boundary
-        # ops as the block entry/exit hooks (activations enter sharded
-        # blocks via f = copy-fwd/psum-bwd, leave via g = psum-fwd/
-        # copy-bwd, so h stays replicated over tp).
-        if tp_axis is not None:
-            import dataclasses
-
-            local_cfg = dataclasses.replace(
-                cfg,
-                n_heads=cfg.n_heads // tp,
-                n_kv_heads=cfg.n_kv_heads // tp,
-                ffn_dim=cfg.ffn_dim // tp,
-                head_dim_override=cfg.head_dim,
-            )
-            layer_kwargs = dict(
-                pre_block=lambda x: copy_fwd_psum_bwd(x, tp_axis),
-                post_block=lambda x: psum_fwd_copy_bwd(x, tp_axis),
-            )
-        else:
-            local_cfg = cfg
-            layer_kwargs = {}
-
+        # truth for the layer math) with the per-shard cfg + Megatron
+        # f/g boundary hooks from _tp_layer_setup (activations enter
+        # sharded blocks via f = copy-fwd/psum-bwd, leave via
+        # g = psum-fwd/copy-bwd, so h stays replicated over tp).
         def stage_forward(stages_, x):
             def one(h, layer):
                 h, _ = layer_apply(
@@ -419,23 +452,9 @@ def pipeline_lm_loss_and_grads(
         )
         (_, _, _, _, _, g_stages, g_embed, g_head, loss_sum) = carry
 
-        # loss lives on the last stage; embed grad on stage 0; head grads
-        # on the last stage — psum over pipe replicates totals everywhere
-        loss = jax.lax.psum(loss_sum, axis) / m_total
-        g_embed = jax.lax.psum(g_embed, axis) / m_total
-        g_head = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, axis) / m_total, g_head
+        loss, g_embed, g_head, g_stages = _reduce_pipeline_grads(
+            loss_sum, g_embed, g_head, g_stages, axis, data_axis, m_total
         )
-        g_stages = jax.tree_util.tree_map(lambda g: g / m_total, g_stages)
-        if data_axis is not None:
-            loss = jax.lax.pmean(loss, data_axis)
-            g_embed = jax.lax.pmean(g_embed, data_axis)
-            g_head = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, data_axis), g_head
-            )
-            g_stages = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, data_axis), g_stages
-            )
         grads = {
             "embed": g_embed,
             "stages": jax.tree_util.tree_map(lambda g: g[None], g_stages),
@@ -453,6 +472,306 @@ def pipeline_lm_loss_and_grads(
         out_specs=(P(), param_specs),
         check_vma=False,
     )
+
+
+def transformer_interleaved_stage_params(
+    params: dict, n_stages: int, n_chunks: int
+) -> dict:
+    """Split transformer params into the INTERLEAVED layout: virtual
+    stage p = v * S + s holds layers [p*K, (p+1)*K); leaves are
+    [V, S, K, ...] so sharding dim 1 over `pipe` hands device s its V
+    chunks {v*S+s} (Megatron virtual-pipeline assignment)."""
+    n_layers = len(params["layers"])
+    total = n_stages * n_chunks
+    if n_layers % total:
+        raise ValueError(
+            f"{n_layers} layers not divisible by {n_stages} stages x "
+            f"{n_chunks} chunks"
+        )
+    k = n_layers // total
+    chunks = []
+    for v in range(n_chunks):
+        per_stage = []
+        for s in range(n_stages):
+            p = v * n_stages + s
+            per_stage.append(
+                stack_stage_params(params["layers"][p * k : (p + 1) * k])
+            )
+        chunks.append(stack_stage_params(per_stage))  # [S, K, ...]
+    return {
+        "embed": params["embed"],
+        "stages": stack_stage_params(chunks),  # [V, S, K, ...]
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def transformer_uninterleave_params(stage_params: dict) -> dict:
+    """Inverse of transformer_interleaved_stage_params."""
+    stages = stage_params["stages"]
+    leaf = jax.tree_util.tree_leaves(stages)[0]
+    v_n, s_n, k_n = leaf.shape[0], leaf.shape[1], leaf.shape[2]
+    layers = []
+    for p in range(v_n * s_n):
+        v, s = p // s_n, p % s_n
+        for ki in range(k_n):
+            layers.append(
+                jax.tree_util.tree_map(lambda x: x[v, s, ki], stages)
+            )
+    return {
+        "embed": stage_params["embed"],
+        "layers": layers,
+        "final_norm": stage_params["final_norm"],
+        "lm_head": stage_params["lm_head"],
+    }
+
+
+def interleaved_pipeline_lm_loss_and_grads(
+    mesh: Mesh,
+    cfg,
+    n_microbatches: int,
+    n_chunks: int,
+    axis: str = "pipe",
+    data_axis: str = None,
+    tp_axis: str = None,
+):
+    """Interleaved (virtual-stage) 1F1B — ``f(stage_params, tokens) ->
+    (loss, grads)`` with ``stage_params`` from
+    transformer_interleaved_stage_params. Same math as the non-
+    interleaved schedule, ~V-fold smaller pipeline bubble (see
+    parallel/interleaved.py for the schedule construction). Composes
+    with ``data_axis`` (microbatch sharding) and ``tp_axis`` (Megatron
+    tensor parallelism inside every chunk) like the non-interleaved
+    version."""
+    from ..models.transformer import (
+        layer_apply,
+        rms_norm,
+        rope_frequencies,
+    )
+    from ..ops.losses import fused_cross_entropy
+    from .interleaved import OP_B, OP_F, build_interleaved_schedule
+
+    n_stages = mesh.shape[axis]
+    sched = build_interleaved_schedule(n_stages, n_chunks, n_microbatches)
+    m_total = n_microbatches
+    tp = mesh.shape[tp_axis] if tp_axis else 1
+    _check_tp_divisibility(cfg, tp)
+    local_cfg, layer_kwargs = _tp_layer_setup(cfg, tp, tp_axis)
+
+    # schedule tables as device-resident constants
+    t_op = jnp.asarray(sched.op)
+    t_chunk = jnp.asarray(sched.chunk)
+    t_mb = jnp.asarray(sched.mb)
+    t_slot = jnp.asarray(sched.slot)
+    t_recv_f_c = jnp.asarray(sched.recv_f_chunk)
+    t_recv_f_s = jnp.asarray(sched.recv_f_slot)
+    t_recv_b_c = jnp.asarray(sched.recv_b_chunk)
+    t_recv_b_s = jnp.asarray(sched.recv_b_slot)
+
+    def local_fn(stage_params, tokens):
+        stage = jax.lax.axis_index(axis)
+        # [V, 1, K, ...] local -> [V, K, ...]
+        stages = jax.tree_util.tree_map(
+            lambda p: p[:, 0], stage_params["stages"]
+        )
+        embed = stage_params["embed"]
+        head = {
+            "final_norm": stage_params["final_norm"],
+            "lm_head": stage_params["lm_head"],
+        }
+        inputs = tokens[:, :, :-1]  # [M, mb, T]
+        targets = tokens[:, :, 1:]
+        m, mb, t = inputs.shape
+        cos, sin = rope_frequencies(cfg, jnp.arange(t))
+
+        def chunk_forward(chunk_params, x):
+            def one(h, layer):
+                h, _ = layer_apply(
+                    h, layer, local_cfg, cos, sin, **layer_kwargs
+                )
+                return h, None
+
+            h, _ = jax.lax.scan(one, x, chunk_params)
+            return h
+
+        def head_loss(head_, y, target):
+            h = rms_norm(y, head_["final_norm"], cfg.norm_eps)
+            logits = (h @ head_["lm_head"]).astype(jnp.float32)
+            b_, t_, v_ = logits.shape
+            losses = fused_cross_entropy(
+                logits.reshape(b_ * t_, v_), target.reshape(-1)
+            )
+            return jnp.mean(losses)
+
+        act_shape = (mb, t, cfg.dim)
+        zero_act = jnp.zeros(act_shape, cfg.dtype)
+        V = n_chunks
+
+        def tick(carry, tau):
+            (fwd_in, bwd_in, in_buf, gin_buf, ring, g_stages, g_embed,
+             g_head, loss_sum) = carry
+            op = t_op[tau, stage]
+            c = t_chunk[tau, stage]
+            mbi = t_mb[tau, stage]
+            slot = t_slot[tau, stage]
+            # route arrivals (trash chunk-slot V when nothing arrives)
+            rf_c = t_recv_f_c[tau, stage]
+            rb_c = t_recv_b_c[tau, stage]
+            in_buf = in_buf.at[
+                jnp.where(rf_c >= 0, rf_c, V), t_recv_f_s[tau, stage]
+            ].set(fwd_in)
+            gin_buf = gin_buf.at[
+                jnp.where(rb_c >= 0, rb_c, V), t_recv_b_s[tau, stage]
+            ].set(bwd_in)
+            in_slot = jnp.mod(mbi, sched.in_depth)
+
+            chunk_params = jax.tree_util.tree_map(lambda p: p[c], stages)
+            is_p0 = jnp.logical_and(c == 0, stage == 0)
+            is_last = jnp.logical_and(c == V - 1, stage == n_stages - 1)
+
+            def f_branch(args):
+                ring, = args
+                x0 = embed[inputs[mbi]].astype(cfg.dtype)
+                x_in = jnp.where(is_p0, x0, in_buf[c, in_slot])
+                y = chunk_forward(chunk_params, x_in)
+                ring = ring.at[c, slot].set(x_in)
+                return y, ring
+
+            def f_skip(args):
+                ring, = args
+                return zero_act, ring
+
+            y_out, ring = jax.lax.cond(op == OP_F, f_branch, f_skip, (ring,))
+
+            def b_branch(args):
+                g_stages, g_embed, g_head, loss_sum = args
+                x_stored = ring[c, slot]
+                y_st, vjp_fn = jax.vjp(chunk_forward, chunk_params, x_stored)
+
+                def seed_last(_):
+                    (loss, (dhead, dy)) = jax.value_and_grad(
+                        head_loss, argnums=(0, 1)
+                    )(head, y_st, targets[mbi])
+                    return dy.astype(cfg.dtype), dhead, loss
+
+                def seed_mid(_):
+                    zero_head = jax.tree_util.tree_map(jnp.zeros_like, head)
+                    return (
+                        gin_buf[c, in_slot],
+                        zero_head,
+                        jnp.zeros((), jnp.float32),
+                    )
+
+                dy, dhead, loss = jax.lax.cond(
+                    is_last, seed_last, seed_mid, None
+                )
+                dchunk, dx = vjp_fn(dy)
+                g_stages = jax.tree_util.tree_map(
+                    lambda g, d: g.at[c].add(d), g_stages, dchunk
+                )
+                g_head = jax.tree_util.tree_map(jnp.add, g_head, dhead)
+
+                def embed_grad(_):
+                    _, evjp = jax.vjp(
+                        lambda e: e[inputs[mbi]].astype(cfg.dtype), embed
+                    )
+                    return evjp(dx)[0]
+
+                g_embed = g_embed + jax.lax.cond(
+                    is_p0, embed_grad, lambda _: jnp.zeros_like(g_embed), None
+                )
+                return g_stages, g_embed, g_head, loss_sum + loss, dx
+
+            def b_skip(args):
+                g_stages, g_embed, g_head, loss_sum = args
+                return g_stages, g_embed, g_head, loss_sum, zero_act
+
+            g_stages, g_embed, g_head, loss_sum, dx_out = jax.lax.cond(
+                op == OP_B,
+                b_branch,
+                b_skip,
+                (g_stages, g_embed, g_head, loss_sum),
+            )
+
+            fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+            fwd_in = jax.lax.ppermute(y_out, axis, fwd_perm)
+            bwd_in = jax.lax.ppermute(dx_out, axis, bwd_perm)
+            return (
+                fwd_in, bwd_in, in_buf, gin_buf, ring, g_stages, g_embed,
+                g_head, loss_sum,
+            ), None
+
+        g_stages0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), stages
+        )
+        g_head0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), head
+        )
+        carry0 = (
+            zero_act,
+            zero_act,
+            jnp.zeros((V + 1, sched.in_depth) + act_shape, cfg.dtype),
+            jnp.zeros((V + 1, sched.in_depth) + act_shape, cfg.dtype),
+            jnp.zeros((V, sched.ring_depth) + act_shape, cfg.dtype),
+            g_stages0,
+            jnp.zeros_like(embed, jnp.float32),
+            g_head0,
+            jnp.zeros((), jnp.float32),
+        )
+        (carry, _) = jax.lax.scan(
+            tick, carry0, jnp.arange(sched.total_ticks, dtype=jnp.int32)
+        )
+        (_, _, _, _, _, g_stages, g_embed, g_head, loss_sum) = carry
+
+        loss, g_embed, g_head, g_stages = _reduce_pipeline_grads(
+            loss_sum, g_embed, g_head, g_stages, axis, data_axis, m_total
+        )
+        grads = {
+            "embed": g_embed,
+            "stages": jax.tree_util.tree_map(lambda g: g[:, None], g_stages),
+            "final_norm": g_head["final_norm"],
+            "lm_head": g_head["lm_head"],
+        }
+        return loss, grads
+
+    param_specs = interleaved_param_specs(axis, tp_axis)
+    tok_spec = P(None, data_axis) if data_axis else P()
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, tok_spec),
+        out_specs=(P(), param_specs),
+        check_vma=False,
+    )
+
+
+def interleaved_param_specs(axis: str = "pipe", tp_axis: str = None) -> dict:
+    """Specs for the interleaved layout ([V, S, K, ...] stage leaves,
+    device dim is 1)."""
+    if tp_axis is None:
+        return {
+            "embed": P(),
+            "stages": P(None, axis),
+            "final_norm": P(),
+            "lm_head": P(),
+        }
+    return {
+        "embed": P(),
+        "stages": {
+            "wq": P(None, axis, None, None, tp_axis),
+            "wk": P(None, axis, None, None, tp_axis),
+            "wv": P(None, axis, None, None, tp_axis),
+            "wo": P(None, axis, None, tp_axis, None),
+            "w_gate": P(None, axis, None, None, tp_axis),
+            "w_up": P(None, axis, None, None, tp_axis),
+            "w_down": P(None, axis, None, tp_axis, None),
+            "attn_norm": P(None, axis, None, None),
+            "ffn_norm": P(None, axis, None, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(),
+    }
 
 
 def make_pipeline_lm_train_step(

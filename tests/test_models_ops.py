@@ -477,3 +477,37 @@ def test_xent_block_rows_scale_with_vocab():
     assert _effective_block_rows(128, 4, 256) == 4  # never exceeds batch
     # divisibility contract: power-of-two blocks divide power-of-two batches
     assert 16384 % _effective_block_rows(128, 16384, 32000) == 0
+
+
+def test_paged_decode_attention_kernel_matches_reference(pallas_interpret):
+    """The Pallas paged-attention decode kernel (block-table streaming,
+    GQA grouping, online softmax) vs the gather reference — random
+    tables, ragged lengths, dead slots, partial final blocks."""
+    from devspace_tpu.ops.paged_attention import (
+        _paged_decode_pallas,
+        paged_decode_reference,
+    )
+
+    rng = np.random.default_rng(0)
+    B, H, Hkv, D = 4, 8, 2, 16
+    n_blocks, bs, MB = 9, 8, 3
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pool_k = jnp.asarray(
+        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+    )
+    pool_v = jnp.asarray(
+        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+    )
+    tables = jnp.asarray(
+        rng.integers(0, n_blocks, size=(B, MB)), dtype=jnp.int32
+    )
+    # ragged: full slot, partial block, single entry, DEAD slot
+    lengths = jnp.asarray([MB * bs, bs + 3, 1, 0], dtype=jnp.int32)
+    got = _paged_decode_pallas(q, pool_k, pool_v, tables, lengths)
+    ref = paged_decode_reference(q, pool_k, pool_v, tables, lengths)
+    # dead slot: reference softmaxes all-masked scores to uniform junk;
+    # the kernel zeroes it — only live slots must agree
+    np.testing.assert_allclose(
+        np.asarray(got[:3]), np.asarray(ref[:3]), rtol=2e-4, atol=2e-5
+    )
+    assert bool(jnp.all(got[3] == 0.0))
