@@ -44,7 +44,8 @@ class InterleavedSchedule:
     n_micro: int  # M microbatches
     total_ticks: int
     ring_depth: int  # max in-flight microbatches per (device, chunk)
-    in_depth: int  # received-activation/grad buffer slots per chunk
+    f_depth: int  # received-activation buffer slots per chunk (fwd edges)
+    b_depth: int  # received-gradient buffer slots per chunk (bwd edges)
     # all [T, S] int32 tables
     op: np.ndarray  # OP_IDLE / OP_F / OP_B
     chunk: np.ndarray  # local chunk the op runs on
@@ -152,21 +153,30 @@ def build_interleaved_schedule(
         for _, delta in events:
             cur += delta
             ring_depth = max(ring_depth, cur)
-    # received-buffer depth: max outstanding per forward edge (produced
-    # at p, not yet consumed at p+1) and per backward edge
-    in_depth = 1
-    for p in range(P - 1):
-        events = []
-        for m in range(M):
-            events.append((f_done[(p, m)], 1))
-            events.append((f_done[(p + 1, m)], -1))
-            events.append((b_done[(p + 1, m)], 1))
-            events.append((b_done[(p, m)], -1))
-        events.sort()
-        cur = 0
-        for _, delta in events:
-            cur += delta
-            in_depth = max(in_depth, cur)
+    # received-buffer depths, PER DIRECTION: max outstanding activations
+    # on any forward edge (produced at p, not yet consumed at p+1) and
+    # max outstanding grads on any backward edge — a combined counter
+    # would over-allocate the (typically depth-1) backward buffer
+    def _edge_depth(produce, consume) -> int:
+        depth = 1
+        for p in range(P - 1):
+            events = []
+            for m in range(M):
+                events.append((produce(p, m), 1))
+                events.append((consume(p, m), -1))
+            events.sort()
+            cur = 0
+            for _, delta in events:
+                cur += delta
+                depth = max(depth, cur)
+        return depth
+
+    f_depth = _edge_depth(
+        lambda p, m: f_done[(p, m)], lambda p, m: f_done[(p + 1, m)]
+    )
+    b_depth = _edge_depth(
+        lambda p, m: b_done[(p + 1, m)], lambda p, m: b_done[(p, m)]
+    )
 
     op_t = np.zeros((total, S), np.int32)
     chunk_t = np.zeros((total, S), np.int32)
@@ -187,17 +197,18 @@ def build_interleaved_schedule(
             slot_t[tau, s] = m % ring_depth
             if op == OP_F and p + 1 < P and tau + 1 < total:
                 recv_f_c[tau + 1, (s + 1) % S] = (p + 1) // S
-                recv_f_s[tau + 1, (s + 1) % S] = m % in_depth
+                recv_f_s[tau + 1, (s + 1) % S] = m % f_depth
             if op == OP_B and p > 0 and tau + 1 < total:
                 recv_b_c[tau + 1, (s - 1) % S] = (p - 1) // S
-                recv_b_s[tau + 1, (s - 1) % S] = m % in_depth
+                recv_b_s[tau + 1, (s - 1) % S] = m % b_depth
     return InterleavedSchedule(
         n_stages=S,
         n_chunks=V,
         n_micro=M,
         total_ticks=total,
         ring_depth=ring_depth,
-        in_depth=in_depth,
+        f_depth=f_depth,
+        b_depth=b_depth,
         op=op_t,
         chunk=chunk_t,
         mb=mb_t,

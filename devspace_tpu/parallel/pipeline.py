@@ -623,7 +623,8 @@ def interleaved_pipeline_lm_loss_and_grads(
             gin_buf = gin_buf.at[
                 jnp.where(rb_c >= 0, rb_c, V), t_recv_b_s[tau, stage]
             ].set(bwd_in)
-            in_slot = jnp.mod(mbi, sched.in_depth)
+            f_slot = jnp.mod(mbi, sched.f_depth)
+            b_slot = jnp.mod(mbi, sched.b_depth)
 
             chunk_params = jax.tree_util.tree_map(lambda p: p[c], stages)
             is_p0 = jnp.logical_and(c == 0, stage == 0)
@@ -632,7 +633,7 @@ def interleaved_pipeline_lm_loss_and_grads(
             def f_branch(args):
                 ring, = args
                 x0 = embed[inputs[mbi]].astype(cfg.dtype)
-                x_in = jnp.where(is_p0, x0, in_buf[c, in_slot])
+                x_in = jnp.where(is_p0, x0, in_buf[c, f_slot])
                 y = chunk_forward(chunk_params, x_in)
                 ring = ring.at[c, slot].set(x_in)
                 return y, ring
@@ -657,7 +658,7 @@ def interleaved_pipeline_lm_loss_and_grads(
                 def seed_mid(_):
                     zero_head = jax.tree_util.tree_map(jnp.zeros_like, head)
                     return (
-                        gin_buf[c, in_slot],
+                        gin_buf[c, b_slot],
                         zero_head,
                         jnp.zeros((), jnp.float32),
                     )
@@ -711,8 +712,8 @@ def interleaved_pipeline_lm_loss_and_grads(
         carry0 = (
             zero_act,
             zero_act,
-            jnp.zeros((V + 1, sched.in_depth) + act_shape, cfg.dtype),
-            jnp.zeros((V + 1, sched.in_depth) + act_shape, cfg.dtype),
+            jnp.zeros((V + 1, sched.f_depth) + act_shape, cfg.dtype),
+            jnp.zeros((V + 1, sched.b_depth) + act_shape, cfg.dtype),
             jnp.zeros((V, sched.ring_depth) + act_shape, cfg.dtype),
             g_stages0,
             jnp.zeros_like(embed, jnp.float32),

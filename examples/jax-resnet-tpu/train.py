@@ -6,33 +6,68 @@ runs on every host, joins the slice via the TPU_WORKER_ID /
 JAX_COORDINATOR_ADDRESS env the chart wires in, and trains data-parallel
 over all 16 chips — gradients psum over ICI, inserted by XLA from the
 sharding annotations (the north star workload).
+
+Multi-host data path: every host loads only ITS slice of the global
+batch (``host_shard``) and ``prefetch_to_device`` assembles the global
+array from process-local shards while overlapping the host->HBM copy
+with the running step. Model/optimizer state is initialized identically
+on every process (same PRNG key) and globalized once.
+
+Sizes are env-overridable so the same script is CI-testable on the
+virtual CPU slice (tests/test_multihost.py runs it 2-process):
+DEVSPACE_EXAMPLE_BATCH (per-chip), DEVSPACE_EXAMPLE_IMAGE,
+DEVSPACE_EXAMPLE_STEPS, DEVSPACE_EXAMPLE_LOG_EVERY.
 """
 
+import os
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from devspace_tpu.models.resnet import ResNet50
 from devspace_tpu.parallel.mesh import create_mesh, multihost_initialize
-from devspace_tpu.training.data import synthetic_imagenet
+from devspace_tpu.training.data import (
+    host_shard,
+    prefetch_to_device,
+    synthetic_imagenet,
+)
 from devspace_tpu.training.trainer import make_classifier_train_step
 
-PER_CHIP_BATCH = 128
-STEPS = 500
+PER_CHIP_BATCH = int(os.environ.get("DEVSPACE_EXAMPLE_BATCH", 128))
+IMAGE_SIZE = int(os.environ.get("DEVSPACE_EXAMPLE_IMAGE", 224))
+STEPS = int(os.environ.get("DEVSPACE_EXAMPLE_STEPS", 500))
+LOG_EVERY = int(os.environ.get("DEVSPACE_EXAMPLE_LOG_EVERY", 20))
 
 
 def main():
     multihost_initialize()
     n = jax.device_count()
-    print(f"process {jax.process_index()}/{jax.process_count()}, {n} chips")
+    print(
+        f"process {jax.process_index()}/{jax.process_count()}, {n} chips",
+        flush=True,
+    )
     mesh = create_mesh({"data": -1})
+    repl = NamedSharding(mesh, P())
+    batch_sharding = NamedSharding(mesh, P("data"))
     global_batch = PER_CHIP_BATCH * n
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    batch_iter = synthetic_imagenet(global_batch)
-    first = next(batch_iter)
-    variables = model.init(jax.random.PRNGKey(0), first["image"][:8], train=False)
+    # every host loads 1/processes of the batch; prefetch assembles the
+    # global array and double-buffers the transfer under the step
+    batches = prefetch_to_device(
+        (host_shard(b) for b in synthetic_imagenet(global_batch, IMAGE_SIZE)),
+        size=2,
+        sharding=batch_sharding,
+    )
+    first = next(batches)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((8, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32),
+        train=False,
+    )
     optimizer = optax.sgd(0.1 * global_batch / 256, momentum=0.9)
     state = {
         "params": variables["params"],
@@ -40,21 +75,34 @@ def main():
         "opt_state": optimizer.init(variables["params"]),
         "step": jnp.zeros((), jnp.int32),
     }
+    if jax.process_count() > 1:
+        # identical on every process (same PRNG key) -> globalize as
+        # replicated arrays the multi-process jit can consume
+        state = jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                repl, np.asarray(x)
+            ),
+            state,
+        )
     step_fn = make_classifier_train_step(
         model.apply, optimizer, mesh=mesh, has_batch_stats=True
     )
     t0 = None
+    batch = first
     for i in range(STEPS):
-        batch = next(batch_iter)
         state, loss = step_fn(state, batch)
+        batch = next(batches)
         if i == 0:
             jax.block_until_ready(loss)
             t0 = time.time()  # exclude compile
-        elif i % 20 == 0:
+        elif i % LOG_EVERY == 0 or i == STEPS - 1:
             jax.block_until_ready(loss)
             rate = global_batch * i / (time.time() - t0)
-            print(f"step {i:4d} loss {float(loss):.3f} {rate:.0f} imgs/sec", flush=True)
-    print("done")
+            print(
+                f"step {i:4d} loss {float(loss):.3f} {rate:.0f} imgs/sec",
+                flush=True,
+            )
+    print("done", flush=True)
 
 
 if __name__ == "__main__":
