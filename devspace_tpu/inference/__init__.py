@@ -1,1 +1,2 @@
 from .engine import InferenceEngine, Request  # noqa: F401
+from .speculative import SpecStats, generate_speculative  # noqa: F401

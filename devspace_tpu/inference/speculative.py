@@ -1,0 +1,167 @@
+"""Speculative decoding: draft-model proposals, target-model verification.
+
+A small DRAFT model greedily proposes ``k`` tokens per round; the TARGET
+model scores all of them in ONE ``decode_block`` dispatch (k+1 positions)
+and the longest matching prefix is committed plus one corrected/bonus
+token — so each target dispatch yields 1..k+1 tokens instead of 1.
+Greedy speculative decoding is LOSSLESS: the committed stream is
+token-for-token identical to greedy decoding with the target alone
+(asserted in tests/test_inference.py), the draft only changes HOW FAST
+tokens commit, never WHICH.
+
+TPU-first cache handling: both models keep dense positional KV caches
+and "rewind" after rejection is free — no copies, no bookkeeping.
+Every decode WRITES a position's K/V before anything attends to it, so
+a rejected proposal's stale cache entry is overwritten the moment the
+corrected token is fed at that position (models/transformer.py
+decode_tokens / decode_block are position-indexed for exactly this).
+
+The round loop runs on host (acceptance length is data-dependent);
+the per-round compute (draft scan + one verification block) is jitted.
+No reference counterpart (the reference ships no serving stack).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer as tfm
+
+
+@dataclass
+class SpecStats:
+    rounds: int = 0
+    proposed: int = 0
+    accepted: int = 0  # draft proposals accepted (excl. corrected/bonus)
+    committed: int = 0  # total tokens committed (incl. corrected/bonus)
+    accept_hist: list = field(default_factory=list)  # per-round accept count
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.committed / self.rounds if self.rounds else 0.0
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def _draft_propose(params, cache, cur, pos0, cfg, k):
+    """Greedy-propose k tokens per sequence -> (proposals [B, k], cache).
+
+    The scan runs k+1 steps: the extra step feeds the LAST proposal so
+    its K/V is written to the draft cache too (otherwise a fully-
+    accepted round would leave a permanent zero hole at that position
+    that every later draft query attends); its own proposal is
+    discarded."""
+
+    def step(carry, j):
+        cache, cur = carry
+        logits, kv = tfm.decode_tokens(params, cache, cur, pos0 + j, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        new_cache = {
+            "k": kv["k"], "v": kv["v"], "length": cache["length"],
+        }
+        return (new_cache, nxt), nxt
+
+    (cache, _), props = jax.lax.scan(
+        step, (cache, cur), jnp.arange(k + 1, dtype=jnp.int32)
+    )
+    return jnp.moveaxis(props, 0, 1)[:, :k], cache  # [B, k]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _verify(params, cache, block, positions, cfg):
+    """Target scores the whole block -> (greedy choices [B, K], cache)."""
+    logits, kv = tfm.decode_block(params, cache, block, positions, cfg)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+
+def generate_speculative(
+    target_params: dict,
+    draft_params: dict,
+    prompt: jax.Array,  # [B, T_prompt] int32
+    target_cfg: tfm.TransformerConfig,
+    draft_cfg: tfm.TransformerConfig,
+    max_new_tokens: int,
+    k: int = 4,
+) -> tuple[jax.Array, SpecStats]:
+    """Greedy speculative generation -> (tokens [B, max_new_tokens],
+    stats). Output is exactly ``tfm.generate(target_params, prompt,
+    target_cfg, max_new_tokens)`` (greedy losslessness)."""
+    b, t_prompt = prompt.shape
+    horizon = t_prompt + max_new_tokens + k + 2
+    # prefill BOTH models in one full-sequence forward each (big MXU
+    # matmuls), seeding the caches from return_kv
+    t_logits, (tk, tv) = tfm.forward(
+        target_params, prompt, target_cfg, return_kv=True
+    )
+    d_logits, (dk, dv) = tfm.forward(
+        draft_params, prompt, draft_cfg, return_kv=True
+    )
+
+    def seed(cfg, ks, vs):
+        cache = tfm.init_kv_cache(cfg, b, horizon)
+        return {
+            "k": cache["k"].at[:, :, :t_prompt].set(ks),
+            "v": cache["v"].at[:, :, :t_prompt].set(vs),
+            "length": jnp.asarray(t_prompt, jnp.int32),
+        }
+
+    t_cache = seed(target_cfg, tk, tv)
+    d_cache = seed(draft_cfg, dk, dv)
+
+    out = np.zeros((b, max_new_tokens + k + 1), np.int64)
+    out[:, 0] = np.asarray(jnp.argmax(t_logits[:, -1], axis=-1))
+    n = np.ones((b,), np.int64)  # committed tokens per sequence
+    stats = SpecStats()
+
+    while int(n.min()) < max_new_tokens:
+        cur = jnp.asarray(out[np.arange(b), n - 1], jnp.int32)  # last committed
+        pos0 = jnp.asarray(t_prompt + n - 1, jnp.int32)  # its position
+        props, d_cache = _draft_propose(
+            draft_params, d_cache, cur, pos0, draft_cfg, k
+        )
+        # verification block: [last committed, prop_0..prop_{k-1}] at
+        # positions pos0..pos0+k; choice[:, j] is the target's token for
+        # position pos0+j+1 -> compare with prop_j; choice[:, k] is the
+        # bonus token when everything matches
+        block = jnp.concatenate([cur[:, None], props], axis=1)  # [B, k+1]
+        positions = pos0[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        choices, t_kv = _verify(
+            target_params, t_cache, block, positions, target_cfg
+        )
+        t_cache = {"k": t_kv["k"], "v": t_kv["v"], "length": t_cache["length"]}
+
+        props_h = np.asarray(props)
+        choices_h = np.asarray(choices)
+        match = props_h == choices_h[:, :k]  # [B, k]
+        accepts = np.where(
+            match.all(axis=1), k, match.argmin(axis=1)
+        )  # accepted proposals per sequence (0..k)
+        round_accepts = []
+        for s in range(b):
+            if n[s] >= max_new_tokens:
+                # finished sequences freeze: no commits, no stats — and
+                # crucially no growth past the out buffer / cache horizon
+                round_accepts.append(-1)
+                continue
+            a = int(accepts[s])
+            # committed this round: a accepted proposals + the target's
+            # corrected (a<k) or bonus (a==k) token
+            out[s, n[s] : n[s] + a] = props_h[s, :a]
+            out[s, n[s] + a] = choices_h[s, a]
+            n[s] += a + 1
+            stats.accepted += a
+            stats.committed += a + 1
+            stats.proposed += k
+            round_accepts.append(a)
+        stats.rounds += 1
+        stats.accept_hist.append(round_accepts)
+
+    return jnp.asarray(out[:, :max_new_tokens], jnp.int32), stats

@@ -299,7 +299,16 @@ def decode_tokens(
     engine (``inference/engine.py`` — every slot at its own depth). RoPE
     angles, the KV scatter and the causal mask are all indexed by
     ``positions``. Static shapes: the cache is preallocated at max_len and
-    masked by position, so the whole decode loop jits once."""
+    masked by position, so the whole decode loop jits once.
+
+    DELIBERATELY kept as its own body rather than delegating to
+    :func:`decode_block` with K=1: the engine's exact-equality contract
+    (paged decode == this dense path at every argmax, including near
+    ties) depends on the historical op graph compiling bit-identically;
+    routing through decode_block (extra reshapes under jit+scan) was
+    observed to drift floats and flip near-tie argmaxes deep into
+    generation. decode_block is tested against this function instead
+    (tests/test_inference.py::test_decode_block_matches_sequential_decode)."""
     b = tokens.shape[0]
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -480,6 +489,78 @@ def prefill_chunk_paged(
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[0] @ params["lm_head"]).astype(jnp.float32)  # [C, vocab]
     return logits, {"k": cur_k, "v": cur_v}
+
+
+def decode_block(
+    params: dict,
+    cache: dict,  # {"k","v"} [L, B, T, Hkv, D]
+    tokens: jax.Array,  # [B, K] int32 token block per sequence
+    positions: jax.Array,  # [B, K] int32 write positions (consecutive)
+    cfg: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """K-token generalization of ``decode_tokens`` -> (logits [B, K,
+    vocab], updated {"k","v"}). Every token attends the cache up to and
+    including its own position (block-causal against per-sequence
+    offsets). The verification forward of speculative decoding: ONE
+    dispatch scores all K drafted tokens instead of K sequential decode
+    steps."""
+    b, kk = tokens.shape
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    max_len = cache["k"].shape[2]
+    # rope at each token's own position: fold [B, K] into the batch dim
+    cos, sin = rope_frequencies(cfg, positions.reshape(-1))  # [B*K, half]
+
+    def rope_bk(x):  # [B, K, H, D] -> rotate at per-(b,k) positions
+        flat = x.reshape(b * kk, 1, x.shape[2], x.shape[3])
+        out = apply_rope(flat, cos, sin, per_batch=True)
+        return out.reshape(b, kk, x.shape[2], x.shape[3])
+
+    batch_idx = jnp.repeat(jnp.arange(b), kk)
+    pos_flat = positions.reshape(-1)
+    h = params["embed"][tokens]  # [B, K, D]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = (x @ layer["wq"]).reshape(b, kk, cfg.n_heads, hd)
+        k = (x @ layer["wk"]).reshape(b, kk, cfg.n_kv_heads, hd)
+        v = (x @ layer["wv"]).reshape(b, kk, cfg.n_kv_heads, hd)
+        q = rope_bk(q)
+        k = rope_bk(k)
+        k_cache = cache["k"][li].at[batch_idx, pos_flat].set(
+            k.reshape(b * kk, cfg.n_kv_heads, hd)
+        )
+        v_cache = cache["v"][li].at[batch_idx, pos_flat].set(
+            v.reshape(b * kk, cfg.n_kv_heads, hd)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        keys = repeat_kv(k_cache, n_rep)  # [B, T, H, D]
+        vals = repeat_kv(v_cache, n_rep)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        mask = (
+            jnp.arange(max_len)[None, None, :] <= positions[:, :, None]
+        )[:, None, :, :]  # [B, 1, K, T]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vals).astype(h.dtype)
+        h = h + (ctx.reshape(b, kk, -1) @ layer["wo"]).astype(h.dtype)
+        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
+        h = h + (gated @ layer["w_down"]).astype(h.dtype)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    # flattened projection: [B*K, D] @ [D, V] — for K=1 this is
+    # bit-identical to the historical decode_tokens ([B, D] @ [D, V]);
+    # a [B, K, D] batched matmul tiles differently and flips near-tie
+    # argmaxes, breaking engine-vs-generate exact-equality tests
+    logits = (
+        (h.reshape(b * kk, -1) @ params["lm_head"])
+        .reshape(b, kk, -1)
+        .astype(jnp.float32)
+    )
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
 
 def decode_step(

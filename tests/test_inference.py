@@ -480,3 +480,120 @@ def test_cascading_preemption_under_extreme_contention(params):
         assert None not in engine._resume
     finally:
         engine.stop()
+
+
+def test_decode_block_matches_sequential_decode(params):
+    """decode_block (K tokens, one dispatch) must equal K sequential
+    decode_tokens calls — same logits, same cache."""
+    from devspace_tpu.models.transformer import (
+        decode_block,
+        decode_tokens,
+        forward,
+        init_kv_cache,
+    )
+
+    b, t0, kk = 2, 5, 3
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(1, CFG.vocab_size, (b, t0)),
+        jnp.int32,
+    )
+    _, (ks, vs) = forward(params, prompt, CFG, return_kv=True)
+    horizon = t0 + kk + 2
+    base = init_kv_cache(CFG, b, horizon)
+    base = {
+        "k": base["k"].at[:, :, :t0].set(ks),
+        "v": base["v"].at[:, :, :t0].set(vs),
+        "length": jnp.asarray(t0, jnp.int32),
+    }
+    toks = jnp.asarray([[7, 3, 9], [1, 4, 2]], jnp.int32)
+    positions = t0 + jnp.tile(jnp.arange(kk), (b, 1))
+
+    blk_logits, blk_kv = decode_block(params, base, toks, positions, CFG)
+
+    cache = dict(base)
+    seq_logits = []
+    for j in range(kk):
+        lg, kv = decode_tokens(
+            params, cache, toks[:, j], positions[:, j], CFG
+        )
+        cache = {"k": kv["k"], "v": kv["v"], "length": cache["length"]}
+        seq_logits.append(lg)
+    np.testing.assert_allclose(
+        np.asarray(blk_logits),
+        np.asarray(jnp.stack(seq_logits, axis=1)),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(blk_kv["k"]), np.asarray(cache["k"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_speculative_greedy_losslessness(params):
+    """Greedy speculative decoding must produce EXACTLY the target
+    model's greedy output, whatever the draft proposes — with a same-
+    weights draft (everything accepted), a different draft (mixed), and
+    across k values."""
+    from devspace_tpu.inference.speculative import generate_speculative
+
+    prompt = jnp.asarray([[5, 1, 4], [2, 9, 9]], jnp.int32)
+    n_new = 12
+    ref = tfm.generate(params, prompt, CFG, max_new_tokens=n_new)
+
+    # draft == target: near-total acceptance (an occasional near-tie
+    # argmax can flip between the single-token and block paths — float
+    # op-order noise, which is exactly what verification exists to absorb)
+    out, stats = generate_speculative(
+        params, params, prompt, CFG, CFG, n_new, k=3
+    )
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+    assert stats.acceptance_rate > 0.9
+    assert stats.tokens_per_round > 3.0  # ~k accepted + bonus per round
+
+    # an unrelated draft: acceptance drops but the output CANNOT change
+    other = tfm.init_params(CFG, jax.random.PRNGKey(123))
+    for k in (1, 2, 4):
+        out, stats = generate_speculative(
+            params, other, prompt, CFG, CFG, n_new, k=k
+        )
+        assert np.array_equal(np.asarray(out), np.asarray(ref)), k
+        assert stats.rounds > 0 and stats.committed >= n_new
+
+
+def test_speculative_freezes_finished_sequences(params):
+    """Divergent per-sequence acceptance (one sequence commits k+1
+    tokens/round, the other crawls at ~1/round) must not overrun the
+    output buffer or the cache horizon — finished sequences freeze while
+    the slow one keeps verifying (regression: the fast sequence
+    previously kept committing past max_new_tokens and crashed)."""
+    from unittest import mock
+
+    from devspace_tpu.inference import speculative
+
+    prompt = jnp.asarray([[5, 1, 4], [2, 9, 9]], jnp.int32)
+    t_prompt = prompt.shape[1]
+    n_new, k = 12, 4
+    ref = np.asarray(tfm.generate(params, prompt, CFG, max_new_tokens=n_new))
+
+    real_propose = speculative._draft_propose
+
+    def skewed_propose(draft_params, cache, cur, pos0, cfg, kk):
+        # seq0 proposes the exact target continuation (full acceptance);
+        # seq1 proposes token 0 (essentially always rejected)
+        pos0_h = np.asarray(pos0)
+        props = np.zeros((2, kk), np.int32)
+        for j in range(kk):
+            idx = int(pos0_h[0]) - t_prompt + 1 + j
+            if idx < ref.shape[1]:
+                props[0, j] = ref[0, idx]
+        return jnp.asarray(props), cache
+
+    with mock.patch.object(speculative, "_draft_propose", skewed_propose):
+        out, stats = speculative.generate_speculative(
+            params, params, prompt, CFG, CFG, n_new, k=k
+        )
+    assert np.array_equal(np.asarray(out), ref)  # still lossless
+    # seq0 froze: rounds after it finished record -1 for it
+    flat0 = [r[0] for r in stats.accept_hist]
+    assert -1 in flat0
+    assert real_propose is speculative._draft_propose  # patch released
