@@ -105,8 +105,23 @@ def scan_stale_processes() -> list[str]:
         if not base.startswith("python"):
             continue
         if "bench.py" in cmd and ("-child" in cmd):
-            # a stale child of a previous (killed) bench run: safe to reap
-            log(f"[bench] killing stale bench child pid={pid}: {cmd[:120]}")
+            # only reap ORPHANED bench children (reparented to init after
+            # their driver was killed) — a cmdline match alone would also
+            # kill the live children of a concurrently running bench
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    child_ppid = int(f.read().split(")")[-1].split()[1])
+            except (OSError, ValueError, IndexError):
+                child_ppid = -1
+            if child_ppid != 1:
+                log(
+                    f"[bench] WARNING: bench child pid={pid} has a live "
+                    f"parent ({child_ppid}) — another bench may be running; "
+                    f"NOT killing, timings suspect"
+                )
+                reports.append(f"seen:{pid}")
+                continue
+            log(f"[bench] killing orphaned bench child pid={pid}: {cmd[:120]}")
             try:
                 os.kill(pid, signal.SIGKILL)
             except OSError:

@@ -985,7 +985,7 @@ def cmd_lint(args) -> int:
     with its configured values (the exact deploy render path), check the
     rendered objects structurally, and check TPU slice invariants at
     render time (the live-pod versions live in `analyze`)."""
-    from ..deploy.chart import ChartError
+    from ..deploy.chart import ChartDeployer, ChartError
     from ..deploy.lint import lint_chart, lint_tpu_consistency, validate_manifests
     from ..deploy.manifests import create_deployer
 
@@ -1009,8 +1009,6 @@ def cmd_lint(args) -> int:
             image_tags.setdefault(k, f"{v.image}:dev")
     issues: list[str] = []
     all_docs: list[dict] = []
-    from ..deploy.chart import ChartDeployer
-
     for d in ctx.config.deployments or []:
         deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
         try:

@@ -394,12 +394,14 @@ def _semver_compare(constraint: Any, version: Any) -> bool:
                 )
                 ok = tgt <= ver < upper
             elif op == "^":
-                if tgt[0] > 0:
+                # Masterminds semantics: precision matters for 0.x —
+                # ^0 == <1.0.0, ^0.0 == <0.1.0, ^0.0.3 == <0.0.4
+                if tgt[0] > 0 or nfields <= 1:
                     upper = (tgt[0] + 1, 0, 0)
-                elif tgt[1] > 0:
-                    upper = (0, tgt[1] + 1, 0)
+                elif tgt[1] > 0 or nfields == 2:
+                    upper = (tgt[0], tgt[1] + 1, 0)
                 else:
-                    upper = (0, 0, tgt[2] + 1)
+                    upper = (tgt[0], tgt[1], tgt[2] + 1)
                 ok = tgt <= ver < upper
             else:  # exact / wildcard prefix ("1.2" matches any 1.2.x)
                 ok = ver[:nfields] == tgt[:nfields] if nfields else True

@@ -27,7 +27,12 @@ from typing import Optional
 
 from ..config import latest
 
-_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+# DNS-1123 SUBDOMAIN (dots allowed): most resource names accept it, and
+# CRDs ('certificates.cert-manager.io') require it — a label-only regex
+# would false-positive on valid charts
+_DNS1123 = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$"
+)
 _WORKLOAD_KINDS = {
     "Deployment",
     "StatefulSet",
@@ -166,11 +171,17 @@ def lint_tpu_consistency(
         slice_workloads += 1
         label = f"{doc.get('kind')}/{(doc.get('metadata') or {}).get('name')}"
         replicas = (doc.get("spec") or {}).get("replicas")
-        if replicas is not None and int(replicas) != workers:
-            issues.append(
-                f"{label}: replicas {replicas} != tpu.workers {workers} "
-                f"(slice atomicity: every worker pod must exist)"
-            )
+        if replicas is not None:
+            try:
+                replicas_n = int(replicas)
+            except (TypeError, ValueError):
+                issues.append(f"{label}: replicas is not an integer ({replicas!r})")
+                replicas_n = None
+            if replicas_n is not None and replicas_n != workers:
+                issues.append(
+                    f"{label}: replicas {replicas} != tpu.workers {workers} "
+                    f"(slice atomicity: every worker pod must exist)"
+                )
         if not requests_tpu:
             issues.append(
                 f"{label}: TPU env wired but no container requests "

@@ -108,13 +108,18 @@ def load_index(repo: str) -> dict[str, list[ChartEntry]]:
 
 
 def _version_key(version: str) -> tuple:
+    """Semver-style ordering key: numeric dotted core, with a
+    pre-release suffix ranking BELOW its release (1.2.3-rc1 < 1.2.3 —
+    `update packages` must never call a pre-release an upgrade over the
+    vendored stable)."""
+    core, _, pre = version.lstrip("v").partition("-")
     parts = []
-    for p in version.lstrip("v").split("."):
+    for p in core.split("."):
         try:
-            parts.append((0, int(p)))
+            parts.append((0, int(p), ""))
         except ValueError:
-            parts.append((1, p))
-    return tuple(parts)
+            parts.append((1, 0, p))
+    return (tuple(parts), 1 if not pre else 0, pre)
 
 
 def search_charts(repo: str, query: str = "") -> list[ChartEntry]:

@@ -748,30 +748,23 @@ def interleaved_pipeline_lm_loss_and_grads(
 
 
 def interleaved_param_specs(axis: str = "pipe", tp_axis: str = None) -> dict:
-    """Specs for the interleaved layout ([V, S, K, ...] stage leaves,
-    device dim is 1)."""
-    if tp_axis is None:
-        return {
-            "embed": P(),
-            "stages": P(None, axis),
-            "final_norm": P(),
-            "lm_head": P(),
-        }
+    """Specs for the interleaved layout: derived from
+    pipeline_param_specs by prefixing the chunk dim (leaves are
+    [V, S, K, ...], device dim is 1) — one source of truth for the
+    per-weight shardings."""
+    base = pipeline_param_specs(axis, tp_axis)
+
+    def prefix(spec: P) -> P:
+        return P(None, *spec)
+
+    stages = base["stages"]
     return {
-        "embed": P(),
-        "stages": {
-            "wq": P(None, axis, None, None, tp_axis),
-            "wk": P(None, axis, None, None, tp_axis),
-            "wv": P(None, axis, None, None, tp_axis),
-            "wo": P(None, axis, None, tp_axis, None),
-            "w_gate": P(None, axis, None, None, tp_axis),
-            "w_up": P(None, axis, None, None, tp_axis),
-            "w_down": P(None, axis, None, tp_axis, None),
-            "attn_norm": P(None, axis, None, None),
-            "ffn_norm": P(None, axis, None, None),
-        },
-        "final_norm": P(),
-        "lm_head": P(),
+        **base,
+        "stages": prefix(stages)
+        if isinstance(stages, P)
+        else jax.tree_util.tree_map(
+            prefix, stages, is_leaf=lambda x: isinstance(x, P)
+        ),
     }
 
 

@@ -280,3 +280,67 @@ def test_cli_update_packages_unknown_name_errors(tmp_path, monkeypatch):
     logutil.set_logger(logutil.StdoutLogger())
     assert cli_main(["init", "--language", "python"]) == 0
     assert cli_main(["update", "packages", "nosuch"]) == 1
+
+
+def test_lint_accepts_subdomain_names_and_bad_replicas():
+    """Dotted DNS-1123 subdomain names (CRDs!) are valid; non-integer
+    replicas must be a lint issue, not a crash."""
+    crd = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "certificates.cert-manager.io"},
+    }
+    assert validate_manifests([crd]) == []
+    assert any(
+        "not DNS-1123" in i
+        for i in validate_manifests(
+            [{"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": "Bad..x"}}]
+        )
+    )
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": "s"},
+        "spec": {
+            "replicas": "bogus",
+            "serviceName": "s",
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "w",
+                            "image": "i",
+                            "env": [{"name": "TPU_WORKER_ID", "value": "0"}],
+                        }
+                    ]
+                }
+            },
+        },
+    }
+    issues = lint_tpu_consistency([sts], TPUConfig(workers=2))
+    assert any("replicas is not an integer" in i for i in issues)
+
+
+def test_version_key_prerelease_below_release():
+    """1.2.3-rc1 must sort BELOW 1.2.3 (update packages must never offer
+    a pre-release as an upgrade over the vendored stable)."""
+    from devspace_tpu.deploy.packages import _version_key
+
+    assert _version_key("1.2.3-rc1") < _version_key("1.2.3")
+    assert _version_key("1.2.3") < _version_key("1.2.4-alpha")
+    assert _version_key("1.2.3-alpha") < _version_key("1.2.3-rc1")
+    assert _version_key("2.0.0") > _version_key("1.9.9")
+
+
+def test_semver_caret_zero_precision():
+    """Masterminds ^ semantics at 0.x depend on constraint precision."""
+    from devspace_tpu.deploy.gotemplate import _semver_compare
+
+    assert _semver_compare("^0.0", "0.0.5") is True
+    assert _semver_compare("^0.0", "0.1.0") is False
+    assert _semver_compare("^0", "0.9.7") is True
+    assert _semver_compare("^0", "1.0.0") is False
+    assert _semver_compare("^0.0.3", "0.0.3") is True
+    assert _semver_compare("^0.0.3", "0.0.4") is False
+    assert _semver_compare("^0.2.3", "0.2.9") is True
+    assert _semver_compare("^0.2.3", "0.3.0") is False
