@@ -1042,14 +1042,152 @@ def _checkout_root() -> str:
     )
 
 
+_VERSION_RE = r"__version__\s*=\s*[\"']([^\"']+)[\"']"
+
+
+def _archive_version(tf) -> tuple[Optional[str], Optional[str]]:
+    """(version, package_root) read from devspace_tpu/__init__.py inside
+    a release tarball. The SHALLOWEST match wins — a vendored/fixture
+    copy deeper in the tree (tests/fixtures/devspace_tpu/...) must never
+    be mistaken for the real package."""
+    import re as _re
+
+    best: tuple[int, str, str] = None
+    for m in tf.getmembers():
+        parts = m.name.split("/")
+        if parts[-2:] == ["devspace_tpu", "__init__.py"]:
+            text = tf.extractfile(m).read().decode("utf-8", "replace")
+            found = _re.search(_VERSION_RE, text)
+            if found and (best is None or len(parts) < best[0]):
+                best = (len(parts), found.group(1), "/".join(parts[:-1]))
+    if best is None:
+        return None, None
+    return best[1], best[2]
+
+
+def _installed_version(checkout: str) -> Optional[str]:
+    """Version of the package INSTALLED at the target checkout (which is
+    not necessarily the running module's __version__)."""
+    import re as _re
+
+    try:
+        with open(
+            os.path.join(checkout, "devspace_tpu", "__init__.py"),
+            encoding="utf-8",
+        ) as fh:
+            found = _re.search(_VERSION_RE, fh.read())
+            return found.group(1) if found else None
+    except OSError:
+        return None
+
+
 def cmd_upgrade(args) -> int:
-    """Reference: cmd/upgrade.go — self-update via GitHub releases. This
-    build is distributed as a repo checkout; --apply runs git pull there."""
+    """Reference: cmd/upgrade.go — self-update via a release artifact
+    (upstream downloads a GitHub release binary and swaps it in). This
+    build's artifact is a source tarball: ``upgrade --archive PATH``
+    validates it, compares versions, and atomically replaces the
+    ``devspace_tpu`` package (backup + rollback on failure) — the
+    egress-free equivalent of the release flow. ``--apply`` keeps the
+    git-checkout pull for development installs. Git checkouts REFUSE
+    --archive without --force: swapping the package inside a working
+    repo destroys uncommitted work (development installs upgrade via
+    git; release installs have no .git)."""
+    import tarfile as _tarfile
+
     log = logutil.get_logger()
     checkout = _checkout_root()
+    archive = getattr(args, "archive", None)
+    if archive:
+        if os.path.exists(os.path.join(checkout, ".git")) and not getattr(
+            args, "force", False
+        ):
+            log.error(
+                "[upgrade] %s is a git checkout — use 'upgrade --apply' "
+                "(git pull) for development installs, or --force to "
+                "overwrite the package anyway (uncommitted changes in "
+                "devspace_tpu/ WILL be lost)",
+                checkout,
+            )
+            return 1
+        pkg_dir = os.path.join(checkout, "devspace_tpu")
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        current = _installed_version(checkout) or __version__
+        force = getattr(args, "force", False)
+        try:
+            with _tarfile.open(archive, "r:*") as tf:
+                new_version, pkg_root = _archive_version(tf)
+                if new_version is None:
+                    log.error(
+                        "[upgrade] %s contains no devspace_tpu/__init__.py "
+                        "with a __version__", archive,
+                    )
+                    return 1
+                if new_version == current and not force:
+                    log.info(
+                        "[upgrade] already at %s (use --force to reinstall)",
+                        current,
+                    )
+                    return 0
+                from ..deploy.packages import _version_key
+
+                if _version_key(new_version) < _version_key(current) and not force:
+                    log.error(
+                        "[upgrade] %s is OLDER than the installed %s — "
+                        "refusing to downgrade (use --force to override)",
+                        new_version, current,
+                    )
+                    return 1
+                # stage INSIDE the checkout: same filesystem, so both
+                # swaps below are atomic os.rename (a cross-device move
+                # could fail half-copied)
+                staging = _tempfile.mkdtemp(
+                    prefix=".devspace-upgrade-", dir=checkout
+                )
+                try:
+                    members = [
+                        m
+                        for m in tf.getmembers()
+                        if m.name == pkg_root
+                        or m.name.startswith(pkg_root + "/")
+                    ]
+                    for m in members:  # refuse path escapes
+                        target = os.path.normpath(os.path.join(staging, m.name))
+                        if not target.startswith(os.path.abspath(staging)):
+                            log.error(
+                                "[upgrade] archive member escapes: %s", m.name
+                            )
+                            return 1
+                    tf.extractall(staging, members=members, filter="data")
+                    new_pkg = os.path.join(staging, pkg_root)
+                    backup = pkg_dir + ".bak"
+                    if os.path.isdir(backup):
+                        _shutil.rmtree(backup)
+                    os.rename(pkg_dir, backup)
+                    try:
+                        os.rename(new_pkg, pkg_dir)
+                    except BaseException:
+                        # clear any partial state, then restore
+                        if os.path.isdir(pkg_dir):
+                            _shutil.rmtree(pkg_dir, ignore_errors=True)
+                        os.rename(backup, pkg_dir)
+                        raise
+                    _shutil.rmtree(backup)
+                finally:
+                    _shutil.rmtree(staging, ignore_errors=True)
+        except (OSError, _tarfile.TarError, EOFError) as e:
+            # tarfile.open only reads the header: a truncated body fails
+            # later in getmembers/extractall — catch the whole flow
+            log.error("[upgrade] cannot read archive %s: %s", archive, e)
+            return 1
+        log.done("[upgrade] %s -> %s (from %s)", current, new_version, archive)
+        return 0
     if not getattr(args, "apply", False):
         log.info(
-            "devspace-tpu %s — run 'devspace-tpu upgrade --apply' to git pull %s",
+            "devspace-tpu %s — run 'devspace-tpu upgrade --apply' to git pull "
+            "%s, or 'upgrade --archive <release.tgz>' to install a release "
+            "artifact",
             __version__,
             checkout,
         )
@@ -1374,6 +1512,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("upgrade", help="upgrade the framework checkout")
     sp.add_argument("--apply", action="store_true", help="run git pull")
+    sp.add_argument(
+        "--archive", help="install a release tarball (source artifact)"
+    )
+    sp.add_argument(
+        "--force",
+        action="store_true",
+        help="reinstall same version / overwrite a git checkout",
+    )
     sp.set_defaults(fn=cmd_upgrade)
 
     sp = sub.add_parser("install", help="install a devspace-tpu launcher on PATH")

@@ -254,3 +254,118 @@ def test_print_manifests_renders_without_applying(tmp_path, monkeypatch, capsys)
     state = json.load(open(tmp_path / "cluster" / "cluster-state.json")) if (
         tmp_path / "cluster" / "cluster-state.json").exists() else {"objects": []}
     assert not state.get("objects")
+
+
+def test_upgrade_from_release_archive(tmp_path, monkeypatch):
+    """Reference parity (upgrade.go downloads a release artifact and swaps
+    the binary): `upgrade --archive` validates a source tarball and
+    atomically replaces the package, with rollback on failure."""
+    import tarfile
+
+    from devspace_tpu.cli import main as cli_main_mod
+    from devspace_tpu.cli.main import main
+
+    logutil.set_logger(logutil.StdoutLogger())
+    # a fake installed checkout
+    checkout = tmp_path / "install"
+    (checkout / "devspace_tpu").mkdir(parents=True)
+    (checkout / "devspace_tpu" / "__init__.py").write_text(
+        '__version__ = "0.1.0"\n'
+    )
+    (checkout / "devspace_tpu" / "old_marker.py").write_text("OLD = 1\n")
+    monkeypatch.setattr(cli_main_mod, "_checkout_root", lambda: str(checkout))
+
+    # a release artifact at 0.2.0 wrapped in a top-level dir
+    rel = tmp_path / "rel" / "devspace-tpu-0.2.0"
+    (rel / "devspace_tpu").mkdir(parents=True)
+    (rel / "devspace_tpu" / "__init__.py").write_text('__version__ = "0.2.0"\n')
+    (rel / "devspace_tpu" / "new_marker.py").write_text("NEW = 2\n")
+    archive = tmp_path / "release.tgz"
+    with tarfile.open(archive, "w:gz") as tf:
+        tf.add(str(rel), arcname="devspace-tpu-0.2.0")
+
+    assert main(["upgrade", "--archive", str(archive)]) == 0
+    assert (checkout / "devspace_tpu" / "new_marker.py").exists()
+    assert not (checkout / "devspace_tpu" / "old_marker.py").exists()
+    assert not (checkout / "devspace_tpu.bak").exists()
+    assert "0.2.0" in (checkout / "devspace_tpu" / "__init__.py").read_text()
+
+    # same INSTALLED version (read from the target checkout, now 0.2.0):
+    # no-op without --force
+    rel2 = tmp_path / "rel2" / "x"
+    (rel2 / "devspace_tpu").mkdir(parents=True)
+    (rel2 / "devspace_tpu" / "__init__.py").write_text('__version__ = "0.2.0"\n')
+    same = tmp_path / "same.tgz"
+    with tarfile.open(same, "w:gz") as tf:
+        tf.add(str(rel2), arcname="x")
+    assert main(["upgrade", "--archive", str(same)]) == 0
+    assert (checkout / "devspace_tpu" / "new_marker.py").exists()  # untouched
+
+    # an OLDER archive is refused (no silent downgrade)
+    rel3 = tmp_path / "rel3" / "x"
+    (rel3 / "devspace_tpu").mkdir(parents=True)
+    (rel3 / "devspace_tpu" / "__init__.py").write_text('__version__ = "0.1.0"\n')
+    old = tmp_path / "old.tgz"
+    with tarfile.open(old, "w:gz") as tf:
+        tf.add(str(rel3), arcname="x")
+    assert main(["upgrade", "--archive", str(old)]) == 1
+    assert "0.2.0" in (checkout / "devspace_tpu" / "__init__.py").read_text()
+
+    # a fixture copy DEEPER in the tree must not shadow the real package
+    rel4 = tmp_path / "rel4" / "devspace-tpu-0.3.0"
+    (rel4 / "tests" / "fixtures" / "devspace_tpu").mkdir(parents=True)
+    (rel4 / "tests" / "fixtures" / "devspace_tpu" / "__init__.py").write_text(
+        '__version__ = "9.9.9"\n'
+    )
+    (rel4 / "devspace_tpu").mkdir(parents=True)
+    (rel4 / "devspace_tpu" / "__init__.py").write_text('__version__ = "0.3.0"\n')
+    (rel4 / "devspace_tpu" / "real_marker.py").write_text("REAL = 3\n")
+    arc4 = tmp_path / "r4.tgz"
+    with tarfile.open(arc4, "w:gz") as tf:
+        # fixture added FIRST so naive first-match would pick it
+        tf.add(
+            str(rel4 / "tests"), arcname="devspace-tpu-0.3.0/tests"
+        )
+        tf.add(
+            str(rel4 / "devspace_tpu"),
+            arcname="devspace-tpu-0.3.0/devspace_tpu",
+        )
+    assert main(["upgrade", "--archive", str(arc4)]) == 0
+    assert (checkout / "devspace_tpu" / "real_marker.py").exists()
+    assert "0.3.0" in (checkout / "devspace_tpu" / "__init__.py").read_text()
+
+    # a truncated tarball errors cleanly (rc 1, no traceback)
+    trunc = tmp_path / "trunc.tgz"
+    trunc.write_bytes(archive.read_bytes()[:200])
+    assert main(["upgrade", "--archive", str(trunc)]) == 1
+
+    # an archive with no package is rejected
+    junk = tmp_path / "junk.tgz"
+    (tmp_path / "junkfile").write_text("nope")
+    with tarfile.open(junk, "w:gz") as tf:
+        tf.add(str(tmp_path / "junkfile"), arcname="junkfile")
+    assert main(["upgrade", "--archive", str(junk)]) == 1
+
+
+def test_upgrade_archive_refuses_git_checkout(tmp_path, monkeypatch):
+    """--archive on a git checkout must refuse without --force: swapping
+    the package inside a working repo destroys uncommitted work."""
+    import tarfile
+
+    from devspace_tpu.cli import main as cli_main_mod
+    from devspace_tpu.cli.main import main
+
+    logutil.set_logger(logutil.StdoutLogger())
+    checkout = tmp_path / "dev"
+    (checkout / "devspace_tpu").mkdir(parents=True)
+    (checkout / "devspace_tpu" / "__init__.py").write_text('__version__ = "0.1.0"\n')
+    (checkout / ".git").mkdir()
+    monkeypatch.setattr(cli_main_mod, "_checkout_root", lambda: str(checkout))
+    rel = tmp_path / "rel" / "x"
+    (rel / "devspace_tpu").mkdir(parents=True)
+    (rel / "devspace_tpu" / "__init__.py").write_text('__version__ = "9.9.9"\n')
+    archive = tmp_path / "r.tgz"
+    with tarfile.open(archive, "w:gz") as tf:
+        tf.add(str(rel), arcname="x")
+    assert main(["upgrade", "--archive", str(archive)]) == 1
+    assert "0.1.0" in (checkout / "devspace_tpu" / "__init__.py").read_text()
