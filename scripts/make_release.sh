@@ -1,0 +1,27 @@
+#!/bin/sh
+# Package a release artifact consumable by `devspace-tpu upgrade
+# --archive` (and by a plain untar-anywhere install): the source
+# package, the native/ C++ sources (devsync builds on first use at the
+# install site — omitting it would silently lose the native scan fast
+# path), docs and examples, wrapped in a versioned top-level directory.
+# No network, no build step — the artifact IS the source.
+set -e
+CALLER_PWD=$PWD
+cd "$(dirname "$0")/.."
+VERSION=$(python -c "import re; print(re.search(r'__version__\s*=\s*[\"\\']([^\"\\']+)', open('devspace_tpu/__init__.py').read()).group(1))")
+NAME="devspace-tpu-$VERSION"
+# resolve OUT against the CALLER's cwd (we cd'd away from it)
+case "${1:-}" in
+    "") mkdir -p dist; OUT="$PWD/dist/$NAME.tgz" ;;
+    /*) OUT="$1" ;;
+    *) OUT="$CALLER_PWD/$1" ;;
+esac
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+mkdir -p "$STAGE/$NAME"
+cp -r devspace_tpu native docs examples README.md "$STAGE/$NAME/"
+# strip caches and native build artifacts
+find "$STAGE" -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+rm -rf "$STAGE/$NAME/native/build"
+tar -C "$STAGE" -czf "$OUT" "$NAME"
+echo "wrote $OUT ($(du -h "$OUT" | cut -f1))"
