@@ -30,12 +30,65 @@ class Server:
         # (devspace_tpu.training.checkpoint); random weights keep the
         # example self-contained.
         params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
+        self.params = params
         self.engine = InferenceEngine(
             params,
             self.cfg,
             max_slots=int(os.environ.get("MAX_SLOTS", 8)),
             chunk_max=int(os.environ.get("CHUNK_MAX", 8)),
         ).start()
+        # lazy draft model for /generate_speculative (DRAFT_MODEL env).
+        # Bypasses the engine, so concurrency is bounded separately: each
+        # in-flight speculative request holds its OWN dense target+draft
+        # caches — unbounded threads would OOM HBM where /generate is
+        # capped by max_slots.
+        import threading
+
+        self._draft = None
+        self._draft_lock = threading.Lock()
+        self._spec_slots = threading.Semaphore(
+            int(os.environ.get("SPEC_CONCURRENCY", 2))
+        )
+
+    def _draft_model(self):
+        with self._draft_lock:  # racing first requests must not init twice
+            if self._draft is None:
+                name = os.environ.get("DRAFT_MODEL", "tiny")
+                cfg = CONFIGS[name]
+                if cfg.vocab_size != self.cfg.vocab_size:
+                    raise ValueError(
+                        f"draft model '{name}' has vocab_size "
+                        f"{cfg.vocab_size} != target {self.cfg.vocab_size} "
+                        f"— a draft must share the target's vocabulary"
+                    )
+                self._draft = (tfm.init_params(cfg, jax.random.PRNGKey(1)), cfg)
+            return self._draft
+
+    def generate_speculative(self, prompt_ids, max_new_tokens, k=4):
+        """Greedy speculative decoding (lossless vs target-only greedy):
+        the draft proposes k tokens/round, the target verifies them in
+        one decode_block dispatch. Returns (tokens, stats dict)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from devspace_tpu.inference import generate_speculative
+
+        draft_params, draft_cfg = self._draft_model()
+        with self._spec_slots:
+            out, stats = generate_speculative(
+                self.params,
+                draft_params,
+                jnp.asarray([prompt_ids], jnp.int32),
+                self.cfg,
+                draft_cfg,
+                max_new_tokens,
+                k=k,
+            )
+        return np.asarray(out[0]).tolist(), {
+            "rounds": stats.rounds,
+            "acceptance_rate": round(stats.acceptance_rate, 3),
+            "tokens_per_round": round(stats.tokens_per_round, 2),
+        }
 
     def generate(
         self,
@@ -87,6 +140,38 @@ def main():
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            if self.path == "/generate_speculative":
+                # greedy-only draft/verify decoding; lossless vs /generate
+                # at temperature 0 (devspace_tpu.inference.speculative).
+                # Sampling/eos fields are REJECTED, not ignored — silently
+                # dropping them would break the losslessness contract.
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    unsupported = [
+                        f
+                        for f in ("temperature", "eos_id", "top_k", "top_p")
+                        if req.get(f) not in (None, 0, 0.0, 1.0)
+                    ]
+                    if unsupported:
+                        self._json(
+                            400,
+                            {
+                                "error": "greedy-only endpoint; unsupported "
+                                f"field(s): {', '.join(unsupported)} — use "
+                                "/generate for sampling/eos"
+                            },
+                        )
+                        return
+                    toks, stats = server.generate_speculative(
+                        req["prompt_ids"],
+                        int(req.get("max_new_tokens", 16)),
+                        k=int(req.get("k", 4)),
+                    )
+                    self._json(200, {"tokens": toks, "speculative": stats})
+                except Exception as e:  # noqa: BLE001
+                    self._json(400, {"error": str(e)})
+                return
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
                 return
