@@ -44,25 +44,38 @@ class Server:
         # capped by max_slots.
         import threading
 
+        # draft CONFIG resolves at startup (operator misconfiguration must
+        # fail fast, like MODEL does); params init stays lazy
+        draft_name = os.environ.get("DRAFT_MODEL", "tiny")
+        if draft_name not in CONFIGS:
+            raise SystemExit(
+                f"DRAFT_MODEL={draft_name!r} unknown "
+                f"(choices: {', '.join(CONFIGS)})"
+            )
+        self._draft_cfg = CONFIGS[draft_name]
+        if self._draft_cfg.vocab_size != self.cfg.vocab_size:
+            raise SystemExit(
+                f"draft model '{draft_name}' has vocab_size "
+                f"{self._draft_cfg.vocab_size} != target "
+                f"{self.cfg.vocab_size} — a draft must share the target's "
+                f"vocabulary"
+            )
         self._draft = None
         self._draft_lock = threading.Lock()
         self._spec_slots = threading.Semaphore(
             int(os.environ.get("SPEC_CONCURRENCY", 2))
         )
+        # dense-cache budget for speculative requests (the engine's
+        # max_len bounds /generate the same way)
+        self.spec_max_len = int(os.environ.get("SPEC_MAX_LEN", 1024))
 
     def _draft_model(self):
         with self._draft_lock:  # racing first requests must not init twice
             if self._draft is None:
-                name = os.environ.get("DRAFT_MODEL", "tiny")
-                cfg = CONFIGS[name]
-                if cfg.vocab_size != self.cfg.vocab_size:
-                    raise ValueError(
-                        f"draft model '{name}' has vocab_size "
-                        f"{cfg.vocab_size} != target {self.cfg.vocab_size} "
-                        f"— a draft must share the target's vocabulary"
-                    )
-                self._draft = (tfm.init_params(cfg, jax.random.PRNGKey(1)), cfg)
-            return self._draft
+                self._draft = tfm.init_params(
+                    self._draft_cfg, jax.random.PRNGKey(1)
+                )
+            return self._draft, self._draft_cfg
 
     def generate_speculative(self, prompt_ids, max_new_tokens, k=4):
         """Greedy speculative decoding (lossless vs target-only greedy):
@@ -73,6 +86,18 @@ class Server:
 
         from devspace_tpu.inference import generate_speculative
 
+        if not 1 <= k <= 16:
+            # k is a jit-static arg: every distinct value compiles its own
+            # draft scan, so an unbounded client-chosen k is also a
+            # compile-cache DoS
+            raise ValueError(f"k must be in [1, 16], got {k}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt_ids) + max_new_tokens + k + 2 > self.spec_max_len:
+            raise ValueError(
+                f"prompt ({len(prompt_ids)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds SPEC_MAX_LEN={self.spec_max_len}"
+            )
         draft_params, draft_cfg = self._draft_model()
         with self._spec_slots:
             out, stats = generate_speculative(
@@ -148,10 +173,16 @@ def main():
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
+                    # PRESENCE-based: a client that sends any of these
+                    # asked for behavior this endpoint cannot honor —
+                    # value-based allowlists silently misinterpret e.g.
+                    # temperature 1.0 or eos_id 0
                     unsupported = [
                         f
-                        for f in ("temperature", "eos_id", "top_k", "top_p")
-                        if req.get(f) not in (None, 0, 0.0, 1.0)
+                        for f in (
+                            "temperature", "eos_id", "top_k", "top_p", "stream"
+                        )
+                        if f in req
                     ]
                     if unsupported:
                         self._json(

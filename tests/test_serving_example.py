@@ -1,0 +1,123 @@
+"""End-to-end HTTP test of examples/llama-inference/serve.py (TINY, CPU):
+healthz, batch generate, streaming, and the speculative endpoint's
+losslessness + input validation. The serving example is a BASELINE.md
+config; it should not only render in tests but actually serve."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+SERVE = os.path.join(REPO, "examples", "llama-inference", "serve.py")
+
+
+def _post(url, body, timeout=240):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.mark.slow
+def test_serving_example_http_end_to_end():
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        MODEL="tiny",
+        MAX_SLOTS="2",
+        SPEC_CONCURRENCY="1",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, SERVE],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    base = "http://127.0.0.1:8000"
+    try:
+        # wait for the port (server compiles nothing until first request)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", 8000), timeout=1):
+                    break
+            except OSError:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()[-2000:]}")
+                time.sleep(0.3)
+        else:
+            pytest.fail("server never opened :8000")
+
+        with urllib.request.urlopen(base + "/healthz", timeout=60) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True and health["model"] == "tiny"
+
+        code, g = _post(
+            base + "/generate", {"prompt_ids": [5, 1, 4], "max_new_tokens": 6}
+        )
+        assert code == 200 and len(g["tokens"]) == 6
+
+        # speculative: lossless vs /generate, stats present
+        code, s = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [5, 1, 4], "max_new_tokens": 6, "k": 2},
+        )
+        assert code == 200
+        assert s["tokens"] == g["tokens"]
+        assert s["speculative"]["rounds"] >= 1
+
+        # sampling/eos/stream fields are rejected by PRESENCE, not value
+        code, err = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [1], "max_new_tokens": 4, "eos_id": 2},
+        )
+        assert code == 400 and "greedy-only" in err["error"]
+        code, err = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [1], "max_new_tokens": 4, "temperature": 1.0},
+        )
+        assert code == 400 and "greedy-only" in err["error"]
+        # resource bounds: oversized horizon and out-of-range k error
+        # cleanly instead of allocating
+        code, err = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [1], "max_new_tokens": 10**8},
+        )
+        assert code == 400 and "SPEC_MAX_LEN" in err["error"]
+        code, err = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [1], "max_new_tokens": 4, "k": 99},
+        )
+        assert code == 400 and "k must be" in err["error"]
+
+        # streaming emits one token line per token then done
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps(
+                {"prompt_ids": [2, 2], "max_new_tokens": 4, "stream": True}
+            ).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
+        assert lines[-1] == {"done": True}
+        assert len([ln for ln in lines if "token" in ln]) == 4
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
