@@ -90,18 +90,29 @@ def test_flagship_example_trains_end_to_end():
     """The flagship examples/jax-resnet-tpu/train.py runs END TO END
     (VERDICT r2 weak #4 tail): mesh construction, host-sharded input
     pipeline via prefetch_to_device, data-parallel ResNet training to
-    completion on the 8-device virtual slice. Runs single-process: the
+    completion on a 4-device virtual slice. Runs single-process: the
     cross-process contract (chart env -> jax.distributed -> psum step)
     is proven by test_two_process_bootstrap above; a 2-process ResNet
     run deadlocks nondeterministically on this ONE-core CI box (two
     Gloo-coupled XLA processes starving each other), so the heavyweight
     model and the process fan-out are exercised separately."""
+    import re
+
     train = os.path.join(REPO, "examples", "jax-resnet-tpu", "train.py")
+    # preserve unrelated XLA flags; replace only the device count
+    # (4 devices: a full ResNet-50 replicated 8x under the rest of the
+    # suite's memory pressure can OOM the child on the 1-core CI box —
+    # observed as a one-in-three full-suite flake)
+    xla = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
     env = dict(
         os.environ,
         PYTHONPATH=REPO,
         JAX_PLATFORMS="cpu",
-        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        XLA_FLAGS=(xla + " --xla_force_host_platform_device_count=4").strip(),
         DEVSPACE_EXAMPLE_BATCH="2",
         DEVSPACE_EXAMPLE_IMAGE="32",
         DEVSPACE_EXAMPLE_STEPS="3",
@@ -109,20 +120,37 @@ def test_flagship_example_trains_end_to_end():
     )
     env.pop("JAX_COORDINATOR_ADDRESS", None)
     env.pop("JAX_NUM_PROCESSES", None)
-    try:
-        out = subprocess.run(
-            [sys.executable, train],
-            capture_output=True,
-            text=True,
-            timeout=900,
-            env=env,
+    out = None
+    for attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, train],
+                capture_output=True,
+                text=True,
+                timeout=900,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            pytest.fail("flagship example wedged (900s)")
+        if out.returncode == 0:
+            break
+        # retry ONLY memory-pressure signatures (killed by signal /
+        # allocator failure) — and loudly, so flakes stay observable;
+        # ordinary failures go red immediately
+        print(
+            f"[flagship] attempt {attempt} failed rc={out.returncode}\n"
+            f"stderr tail: {out.stderr[-1500:]}"
         )
-    except subprocess.TimeoutExpired:
-        pytest.fail("flagship example wedged (900s)")
+        pressure = out.returncode < 0 or any(
+            s in out.stderr
+            for s in ("MemoryError", "RESOURCE_EXHAUSTED", "out of memory")
+        )
+        if not pressure:
+            break
     assert out.returncode == 0, (
         f"train.py failed rc={out.returncode}\nstdout:{out.stdout}\n"
         f"stderr:{out.stderr[-3000:]}"
     )
-    assert "process 0/1, 8 chips" in out.stdout
+    assert "process 0/1, 4 chips" in out.stdout
     assert "done" in out.stdout
     assert "loss" in out.stdout
