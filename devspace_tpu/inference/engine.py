@@ -141,12 +141,13 @@ class Request:
 class _Slot:
     __slots__ = (
         "req", "length", "remaining", "last_token",
-        "ready", "prefill_pos", "prompt", "admitted_at",
+        "ready", "prefill_pos", "prompt", "admitted_at", "draft_ready",
     )
 
     def __init__(self):
         self.req: Optional[Request] = None
         self.ready = False
+        self.draft_ready = False
 
 
 class InferenceEngine:
@@ -175,13 +176,33 @@ class InferenceEngine:
         block_size: int = 64,
         n_blocks: Optional[int] = None,
         prefill_chunk: int = 512,
+        draft_params: Optional[dict] = None,
+        draft_cfg: Optional[tfm.TransformerConfig] = None,
+        spec_k: int = 4,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
         sharded over its head dim on ``model_axis`` (requires
         ``n_kv_heads % mesh.shape[model_axis] == 0``); the decode jit then
         runs under GSPMD, which inserts the attention/FFN collectives.
-        Scheduling is unchanged — TP is invisible to the slot machinery."""
+        Scheduling is unchanged — TP is invisible to the slot machinery.
+
+        ``draft_params``/``draft_cfg`` turn on ENGINE-level speculative
+        decoding: every iteration, eligible slots (greedy and far enough
+        from max_len) ride one fused dispatch — a ``spec_k``-token draft
+        proposal scan plus a single paged-pool verification block
+        (``models.transformer.decode_block_paged``) — committing 1..k+1
+        tokens per round, while ineligible slots take the plain decode
+        chunk in the SAME iteration (nothing starves). The draft keeps a
+        DENSE per-slot KV cache ``[L, max_slots, max_len, Hkv_d, D_d]``:
+        paging exists to bound the TARGET's multi-GB K/V — a draft is
+        chosen ~10x smaller, so its dense cache is the cheap price of
+        keeping the block allocator single-model. Greedy speculative
+        decoding is LOSSLESS (the committed stream equals plain greedy
+        decoding token-for-token) and never depends on draft-cache
+        contents — a garbage draft only lowers acceptance — so draft
+        state needs no preemption/recovery bookkeeping: preempted slots
+        simply re-prefill both models on re-admission."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -229,6 +250,20 @@ class InferenceEngine:
                 params,
                 tfm.param_partition_spec(cfg, model_axis=model_axis),
             )
+            if draft_params is not None:
+                if draft_cfg is None:
+                    raise ValueError("draft_params requires draft_cfg")
+                if draft_cfg.n_kv_heads % mesh.shape[model_axis]:
+                    raise ValueError(
+                        f"draft n_kv_heads {draft_cfg.n_kv_heads} not "
+                        f"divisible by mesh axis '{model_axis}' "
+                        f"({mesh.shape[model_axis]})"
+                    )
+                draft_params = jax.tree_util.tree_map(
+                    lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                    draft_params,
+                    tfm.param_partition_spec(draft_cfg, model_axis=model_axis),
+                )
 
         def fresh_pool():
             pool = tfm.init_paged_pool(cfg, self.n_blocks, self.block_size)
@@ -240,6 +275,46 @@ class InferenceEngine:
 
         self._fresh_pool = fresh_pool
         self.pool = fresh_pool()
+
+        # speculative decoding state (None/unused when no draft model)
+        if draft_params is not None and draft_cfg is None:
+            raise ValueError("draft_params requires draft_cfg")
+        if spec_k < 1 or spec_k > 16:
+            raise ValueError("spec_k must be in 1..16")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec_k = int(spec_k)
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_committed = 0
+
+        def fresh_draft_cache():
+            if draft_params is None:
+                return None
+            # +spec_k+1 scratch TAIL: a parked slot's propose scan still
+            # scatters k+1 K/V writes into its own row — pointing parked
+            # rows at pos0=max_len lands those writes in the tail, where
+            # no live position ever reads (eligibility caps live writes
+            # at max_len-1). Without this, a spec round running in the
+            # same scheduler iteration that completed a peer's draft
+            # prefill would overwrite the freshly-seeded prompt K/V at
+            # positions 0..k and permanently poison that slot's
+            # proposals (still lossless — verification absorbs it — but
+            # acceptance collapses to ~0).
+            c = tfm.init_kv_cache(
+                draft_cfg, max_slots, self.max_len + self.spec_k + 1
+            )
+            if pool_sharding is not None:
+                c = {
+                    "k": jax.device_put(c["k"], pool_sharding),
+                    "v": jax.device_put(c["v"], pool_sharding),
+                    "length": c["length"],
+                }
+            return c
+
+        self._fresh_draft_cache = fresh_draft_cache
+        self._draft_cache = fresh_draft_cache()
         # host-side allocator state
         self._free_blocks: list[int] = list(range(1, self.n_blocks))
         self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
@@ -335,6 +410,55 @@ class InferenceEngine:
             donate_argnums=1,
         )
 
+        if draft_params is not None:
+            from .speculative import _draft_propose
+
+            k_spec = self.spec_k
+
+            def spec_round(
+                t_params, d_params, pool, d_cache, tables, cur, pos0_d, pos0_v
+            ):
+                """One fused speculative round over the full slot batch:
+                draft-propose k tokens (dense per-slot cache, scan) +
+                ONE paged verification block on the target — a single
+                host round-trip commits 1..k+1 tokens per eligible slot.
+                Parked slots ride along with zeroed tables, draft
+                positions in the scratch tail (pos0_d=max_len) and
+                verify positions at 0 (scratch block 0); their outputs
+                are discarded. Active slots have pos0_d == pos0_v."""
+                props, d_cache = _draft_propose(
+                    d_params, d_cache, cur, pos0_d, draft_cfg, k_spec
+                )
+                block = jnp.concatenate([cur[:, None], props], axis=1)
+                positions = (
+                    pos0_v[:, None]
+                    + jnp.arange(k_spec + 1, dtype=jnp.int32)[None]
+                )
+                logits, pool = tfm.decode_block_paged(
+                    t_params, pool, tables, block, positions, cfg
+                )
+                choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return pool, d_cache, props, choices
+
+            self._spec_round_jit = jax.jit(spec_round, donate_argnums=(2, 3))
+
+            def draft_prefill(d_params, d_cache, tokens, slot_idx):
+                # one full-sequence draft forward (big MXU matmuls) seeds
+                # the slot's dense cache row; pad-tail K/V past the real
+                # prompt is rewritten by the propose scan before anything
+                # attends it (write-before-read, as everywhere)
+                c = tokens.shape[0]
+                _, (dk, dv) = tfm.forward(
+                    d_params, tokens[None], draft_cfg, return_kv=True
+                )
+                return {
+                    "k": d_cache["k"].at[:, slot_idx, :c].set(dk[:, 0]),
+                    "v": d_cache["v"].at[:, slot_idx, :c].set(dv[:, 0]),
+                    "length": d_cache["length"],
+                }
+
+            self._draft_prefill_jit = jax.jit(draft_prefill, donate_argnums=1)
+
     # -- public api --------------------------------------------------------
     def submit(
         self,
@@ -403,6 +527,15 @@ class InferenceEngine:
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
             if uptime > 0
             else 0.0,
+            "spec_rounds": self.spec_rounds,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_committed": self.spec_committed,
+            "spec_acceptance": round(
+                self.spec_accepted / self.spec_proposed, 4
+            )
+            if self.spec_proposed
+            else 0.0,
         }
 
     def stop(self) -> None:
@@ -437,13 +570,16 @@ class InferenceEngine:
         self._tables[slot_idx, :] = 0
         self._nalloc[slot_idx] = 0
 
-    def _decode_tables(self) -> jax.Array:
-        """Block tables for the decode dispatch: mid-prefill and empty
-        slots get an all-zeros row so their garbage write lands in the
-        scratch block instead of clobbering prefilled K/V."""
+    def _decode_tables(self, include=None) -> jax.Array:
+        """Block tables for a dispatch: slots outside ``include`` (default:
+        all ready slots) get an all-zeros row so their garbage write lands
+        in the scratch block instead of clobbering prefilled K/V."""
         t = self._tables.copy()
         for i, s in enumerate(self.slots):
-            if s.req is None or not s.ready:
+            if include is not None:
+                if i not in include:
+                    t[i, :] = 0
+            elif s.req is None or not s.ready:
                 t[i, :] = 0
         return jnp.asarray(t)
 
@@ -538,6 +674,7 @@ class InferenceEngine:
         slot.prompt = prompt
         slot.prefill_pos = 0
         slot.ready = False
+        slot.draft_ready = False
         slot.length = len(prompt)
         slot.remaining = req.max_new_tokens - len(req.tokens)
         slot.admitted_at = time.monotonic()
@@ -585,8 +722,49 @@ class InferenceEngine:
             first = sample_logits(
                 sub, logits[real - 1], req.temperature, req.top_k, req.top_p
             )
+            if self.draft_params is not None and req.temperature <= 0:
+                self._draft_prefill(slot_idx)
             slot.ready = True
             self._emit(slot_idx, int(first))
+
+    def _draft_prefill(self, slot_idx: int) -> None:
+        """Seed the slot's dense draft-cache row in ONE bucketed forward
+        (shape-keyed jit: one compile per power-of-two prompt bucket).
+        The draft is small, so a single full-prompt dispatch stays well
+        under the target's per-chunk cost bound."""
+        slot = self.slots[slot_idx]
+        t = len(slot.prompt)
+        c = 1
+        while c < t:
+            c *= 2
+        c = min(c, self.max_len)
+        toks = slot.prompt + [0] * (c - t)
+        self._draft_cache = self._draft_prefill_jit(
+            self.draft_params,
+            self._draft_cache,
+            jnp.asarray(toks, jnp.int32),
+            jnp.asarray(slot_idx, jnp.int32),
+        )
+        slot.draft_ready = True
+
+    def _reset_draft_cache(self) -> None:
+        """After a dispatch failure that may have consumed the donated
+        draft cache: rebuild it empty and stop speccing resident slots
+        (they fall back to plain decode — losslessness never depended on
+        draft state, so nothing else needs repair)."""
+        if self.draft_params is None:
+            return
+        try:
+            lost = any(
+                hasattr(a, "is_deleted") and a.is_deleted()
+                for a in (self._draft_cache["k"], self._draft_cache["v"])
+            )
+        except Exception:  # noqa: BLE001 — conservative: rebuild
+            lost = True
+        if lost:
+            self._draft_cache = self._fresh_draft_cache()
+            for s in self.slots:
+                s.draft_ready = False
 
     def _preempt_youngest(self, keep: Optional[int] = None) -> bool:
         """Free the most recently admitted slot (ready OR mid-prefill),
@@ -698,17 +876,45 @@ class InferenceEngine:
                         req.error = str(e)
                         self.requests_failed += 1
                     self._recover_pool_if_lost()
+                    self._reset_draft_cache()  # draft prefill may have died
                     if req is not None:
                         req.done.set()  # done LAST (see _emit)
                 if not ready:
                     continue  # nothing to decode yet — keep prefilling
             if not ready:
                 continue
-            # grow every ready slot's table to cover this decode chunk's
-            # writes; preempt youngest-first when the pool runs dry
-            want = max(self.slots[i].remaining for i in ready)
-            room = min(self.max_len - self.slots[i].length for i in ready)
-            k_steps = self._pick_chunk(max(1, min(want, room + 1)))
+            # split ready slots into the SPECULATIVE group (greedy, draft
+            # cache seeded, far enough from max_len that the k+1-token
+            # verification block fits) and the PLAIN decode group; both
+            # dispatch in the same iteration so neither starves — a slot
+            # that outgrows spec eligibility (near max_len, monotone)
+            # simply finishes on the plain path
+            spec_idx: list[int] = []
+            if self.draft_params is not None:
+                spec_idx = [
+                    i
+                    for i in ready
+                    if self.slots[i].req.temperature <= 0
+                    and self.slots[i].draft_ready
+                    and self.slots[i].length + self.spec_k <= self.max_len
+                ]
+            plain = [i for i in ready if i not in spec_idx]
+            # Plain chunk size: sized to the LONGEST remaining want
+            # (rounded down to a compiled power of two) — clamping to the
+            # shortest would put the whole batch back in the one-round-
+            # trip-per-token regime whenever any short request is
+            # co-resident. Slots that finish mid-chunk (EOS or
+            # remaining=0) truncate host-side; the overshoot compute is
+            # already paid by the static batch.
+            if plain:
+                want = max(self.slots[i].remaining for i in plain)
+                room = min(self.max_len - self.slots[i].length for i in plain)
+                k_steps = self._pick_chunk(max(1, min(want, room + 1)))
+            else:
+                k_steps = 1
+            # grow every participating slot's table to cover this
+            # iteration's writes; preempt youngest-first when the pool
+            # runs dry
             for i in list(ready):
                 s = self.slots[i]
                 if s.req is None or not s.ready:
@@ -716,10 +922,15 @@ class InferenceEngine:
                     # pass grew its table — it no longer participates
                     ready.remove(i)
                     continue
-                # writes never pass max_len-1 (the decode scan clamps its
-                # positions), so coverage past max_len is never needed —
-                # and would index past the table row
-                need_upto = min(s.length + k_steps, self.max_len)
+                if i in spec_idx:
+                    # verification writes positions length-1..length-1+k
+                    # (eligibility guarantees length+k <= max_len)
+                    need_upto = s.length + self.spec_k
+                else:
+                    # writes never pass max_len-1 (the decode scan clamps
+                    # its positions), so coverage past max_len is never
+                    # needed — and would index past the table row
+                    need_upto = min(s.length + k_steps, self.max_len)
                 while not self._alloc(i, need_upto):
                     if not self._preempt_youngest(keep=i):
                         # nothing else to evict: requeue this slot itself
@@ -729,55 +940,71 @@ class InferenceEngine:
                         break
                 if s.req is None:  # got preempted itself
                     ready.remove(i)
-            if not ready:
+            # liveness re-filter for BOTH groups: _preempt_youngest picks
+            # by admitted_at, not index order, so a victim whose own
+            # alloc turn already passed is still listed — the dispatch
+            # arrays below must never see a req=None slot as live
+            spec_idx = [
+                i
+                for i in spec_idx
+                if self.slots[i].req is not None and self.slots[i].ready
+            ]
+            plain = [
+                i
+                for i in plain
+                if self.slots[i].req is not None and self.slots[i].ready
+            ]
+            if spec_idx:
+                self._run_spec_round(spec_idx)
+                # spec commits may complete slots and free blocks; the
+                # plain dispatch below rebuilds its views from live state
+                plain = [
+                    i
+                    for i in plain
+                    if self.slots[i].req is not None and self.slots[i].ready
+                ]
+            if not plain:
                 continue
+            plain_set = set(plain)
             tokens = jnp.asarray(
                 [
-                    (s.last_token if s.req is not None and s.ready else 0)
-                    for s in self.slots
+                    (s.last_token if i in plain_set else 0)
+                    for i, s in enumerate(self.slots)
                 ],
                 dtype=jnp.int32,
             )
             positions = jnp.asarray(
                 [
-                    (s.length - 1 if s.req is not None and s.ready else 0)
-                    for s in self.slots
+                    (s.length - 1 if i in plain_set else 0)
+                    for i, s in enumerate(self.slots)
                 ],
                 dtype=jnp.int32,
             )
             temps = jnp.asarray(
                 [
-                    (s.req.temperature if s.req is not None and s.ready else 0.0)
-                    for s in self.slots
+                    (s.req.temperature if i in plain_set else 0.0)
+                    for i, s in enumerate(self.slots)
                 ],
                 dtype=jnp.float32,
             )
             top_ks = jnp.asarray(
                 [
-                    (s.req.top_k if s.req is not None and s.ready else 0)
-                    for s in self.slots
+                    (s.req.top_k if i in plain_set else 0)
+                    for i, s in enumerate(self.slots)
                 ],
                 dtype=jnp.int32,
             )
             top_ps = jnp.asarray(
                 [
-                    (s.req.top_p if s.req is not None and s.ready else 1.0)
-                    for s in self.slots
+                    (s.req.top_p if i in plain_set else 1.0)
+                    for i, s in enumerate(self.slots)
                 ],
                 dtype=jnp.float32,
             )
-            # Chunk size: sized to the LONGEST remaining want (rounded
-            # down to a compiled power of two) — clamping to the shortest
-            # would put the whole batch back in the one-round-trip-per-
-            # token regime whenever any short request is co-resident.
-            # Slots that finish mid-chunk (EOS or remaining=0) truncate
-            # host-side; the overshoot compute is already paid by the
-            # static batch. Only the max_len write bound is a hard clamp.
             filters_on = any(
-                s.req is not None
-                and s.ready
+                i in plain_set
                 and (s.req.top_k > 0 or s.req.top_p < 1.0)
-                for s in self.slots
+                for i, s in enumerate(self.slots)
             )
             try:
                 self.pool, self._keys, toks = self._decode_chunk[
@@ -785,7 +1012,7 @@ class InferenceEngine:
                 ](
                     self.params,
                     self.pool,
-                    self._decode_tables(),
+                    self._decode_tables(include=plain_set),
                     tokens,
                     positions,
                     temps,
@@ -794,7 +1021,7 @@ class InferenceEngine:
                     self._keys,
                 )
                 toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
-                for i in ready:
+                for i in plain:
                     for j in range(k_steps):
                         if self.slots[i].req is None:
                             break  # finished mid-chunk; rest is speculative
@@ -806,3 +1033,79 @@ class InferenceEngine:
                 # serving new requests.
                 self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
                 self._reset_pool()  # donated buffer is gone
+                self._reset_draft_cache()
+
+    def _run_spec_round(self, spec_idx: list[int]) -> None:
+        """One speculative round for ``spec_idx`` slots (others parked):
+        the draft proposes ``spec_k`` tokens per slot, the target scores
+        them in ONE paged verification block, and the longest matching
+        prefix plus one corrected/bonus token commit — 1..k+1 tokens per
+        dispatch. Commits come ONLY from the target's argmax choices, so
+        the stream is exactly the plain greedy stream regardless of what
+        the draft proposed (losslessness; asserted in
+        tests/test_inference.py)."""
+        spec_set = set(spec_idx)
+        cur = jnp.asarray(
+            [
+                (s.last_token if i in spec_set else 0)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.int32,
+        )
+        pos0_draft = jnp.asarray(
+            [
+                (s.length - 1 if i in spec_set else self.max_len)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.int32,
+        )
+        pos0_verify = jnp.asarray(
+            [
+                (s.length - 1 if i in spec_set else 0)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.int32,
+        )
+        try:
+            self.pool, self._draft_cache, props, choices = self._spec_round_jit(
+                self.params,
+                self.draft_params,
+                self.pool,
+                self._draft_cache,
+                self._decode_tables(include=spec_set),
+                cur,
+                pos0_draft,
+                pos0_verify,
+            )
+            props = np.asarray(jax.device_get(props))  # [B, k]
+            choices = np.asarray(jax.device_get(choices))  # [B, k+1]
+        except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
+            # pool and draft cache were both donated into the failed call
+            self._fail_outstanding(
+                f"speculative round failed: {e}", drain_queue=False
+            )
+            self._reset_pool()
+            self._reset_draft_cache()
+            return
+        self.spec_rounds += 1
+        k = self.spec_k
+        for i in spec_idx:
+            match = props[i] == choices[i, :k]
+            a = int(k if match.all() else match.argmin())
+            # accepted/proposed measure the DRAFT-MATCH rate (the number
+            # the operator tunes draft choice and SPEC_K by) — raw a,
+            # not capped by how many tokens the request had room to
+            # commit; spec_committed counts actual emits
+            self.spec_proposed += k
+            self.spec_accepted += a
+            committed = 0
+            for j in range(a):
+                if self.slots[i].req is None:
+                    break  # hit EOS / max_new mid-commit
+                self._emit(i, int(props[i, j]))
+                committed += 1
+            if self.slots[i].req is not None:
+                # the target's corrected (a<k) or bonus (a==k) token
+                self._emit(i, int(choices[i, a]))
+                committed += 1
+            self.spec_committed += committed

@@ -95,7 +95,16 @@ def generate_speculative(
     stats). Output is exactly ``tfm.generate(target_params, prompt,
     target_cfg, max_new_tokens)`` (greedy losslessness)."""
     b, t_prompt = prompt.shape
-    horizon = t_prompt + max_new_tokens + k + 2
+    # Cache horizon bound (ADVICE r3): a FROZEN sequence (n >= max_new)
+    # keeps riding draft/verify rounds while slower batchmates finish,
+    # writing positions pos0..pos0+k every round at its frozen
+    # pos0 = t_prompt + n - 1 <= t_prompt + max_new + k - 1 (commits can
+    # overshoot max_new by up to k) — so the max write position is
+    # t_prompt + max_new + 2k - 1, and the horizon must cover it. An
+    # undersized horizon only survived because JAX drops out-of-bounds
+    # scatters; under a clamping scatter mode the overflow would corrupt
+    # the last cache row (tests/test_inference.py pins this bound).
+    horizon = t_prompt + max_new_tokens + 2 * k
     # prefill BOTH models in one full-sequence forward each (big MXU
     # matmuls), seeding the caches from return_kv
     t_logits, (tk, tv) = tfm.forward(

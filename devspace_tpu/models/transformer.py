@@ -563,6 +563,86 @@ def decode_block(
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
 
+def decode_block_paged(
+    params: dict,
+    pool: dict,  # {"k","v"} [L, n_blocks, bs, Hkv, D]
+    tables: jax.Array,  # [B, max_blocks] int32 block ids
+    tokens: jax.Array,  # [B, K] int32 token block per sequence
+    positions: jax.Array,  # [B, K] int32 write positions (consecutive)
+    cfg: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """K-token generalization of ``decode_tokens_paged`` -> (logits
+    [B, K, vocab], pool') — the verification forward for ENGINE-level
+    speculative decoding (inference/engine.py).
+
+    Each token (b, j) scatters its K/V into
+    ``(tables[b, p // bs], p % bs)`` and attends its slot's pooled cache
+    up to and including its own position: the flat (b, j) rows are fed to
+    the paged-attention kernel as independent queries sharing their
+    slot's table, with per-row ``lengths = position + 1`` — so the same
+    Pallas kernel / gather reference serves 1-token decode and K-token
+    verification unchanged. All K writes of a layer land before that
+    layer attends, preserving the rewind-free contract of
+    ``decode_block``: a previous round's rejected-proposal K/V at
+    positions >= the block start is rewritten here before anything reads
+    it. Parked slots (engine convention) arrive with a zeroed table row
+    and positions starting at 0, so their writes land in scratch block 0."""
+    from ..ops.paged_attention import paged_decode_attention
+
+    b, kk = tokens.shape
+    hd = cfg.head_dim
+    bs = pool["k"].shape[2]
+    pos_flat = positions.reshape(-1)  # [B*K]
+    cos, sin = rope_frequencies(cfg, pos_flat)
+
+    def rope_bk(x):  # [B, K, H, D] -> rotate at per-(b,k) positions
+        flat = x.reshape(b * kk, 1, x.shape[2], x.shape[3])
+        out = apply_rope(flat, cos, sin, per_batch=True)
+        return out.reshape(b, kk, x.shape[2], x.shape[3])
+
+    batch_flat = jnp.repeat(jnp.arange(b), kk)
+    blk = tables[batch_flat, pos_flat // bs]  # [B*K] pool block per token
+    off = pos_flat % bs
+    tables_flat = jnp.repeat(tables, kk, axis=0)  # [B*K, MB]
+    lengths = pos_flat + 1  # each token attends <= its own position
+    h = params["embed"][tokens]  # [B, K, D]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
+        q = (x @ layer["wq"]).reshape(b, kk, cfg.n_heads, hd)
+        k = (x @ layer["wk"]).reshape(b, kk, cfg.n_kv_heads, hd)
+        v = (x @ layer["wv"]).reshape(b, kk, cfg.n_kv_heads, hd)
+        q = rope_bk(q)
+        k = rope_bk(k)
+        k_pool = pool["k"][li].at[blk, off].set(
+            k.reshape(b * kk, cfg.n_kv_heads, hd)
+        )
+        v_pool = pool["v"][li].at[blk, off].set(
+            v.reshape(b * kk, cfg.n_kv_heads, hd)
+        )
+        new_k.append(k_pool)
+        new_v.append(v_pool)
+        ctx = paged_decode_attention(
+            q.reshape(b * kk, cfg.n_heads, hd),
+            k_pool,
+            v_pool,
+            tables_flat,
+            lengths,
+        )  # [B*K, H, D]
+        h = h + (ctx.reshape(b, kk, -1) @ layer["wo"]).astype(h.dtype)
+        x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])
+        h = h + (gated @ layer["w_down"]).astype(h.dtype)
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    # flattened projection for bit-parity with decode_tokens_paged (K=1)
+    logits = (
+        (h.reshape(b * kk, -1) @ params["lm_head"])
+        .reshape(b, kk, -1)
+        .astype(jnp.float32)
+    )
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 def decode_step(
     params: dict,
     cache: dict,

@@ -21,6 +21,10 @@ from devspace_tpu.models import transformer as tfm
 CONFIGS = {"tiny": tfm.TINY, "llama2-7b": tfm.LLAMA2_7B, "llama2-13b": tfm.LLAMA2_13B}
 
 
+class SpecDisabled(RuntimeError):
+    """Speculative decoding was disabled at startup (SPEC=0)."""
+
+
 class Server:
     def __init__(self):
         name = os.environ.get("MODEL", "tiny")
@@ -31,88 +35,85 @@ class Server:
         # example self-contained.
         params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
         self.params = params
+        # Speculative decoding lives IN the engine (draft proposals are
+        # verified against the paged KV pool, coexisting with continuous
+        # batching, admission and preemption) — /generate_speculative
+        # submits greedy requests to the same engine as /generate, so
+        # concurrency, HBM and preemption policy are bounded once, by
+        # max_slots and the block pool. SPEC=0 disables the draft model.
+        self.spec_k = int(os.environ.get("SPEC_K", 4))
+        draft_params = draft_cfg = None
+        # Default draft policy: self-draft only for the TINY demo config
+        # (self-contained, negligible HBM). For real models a self-draft
+        # would eagerly double weight HBM and add a target-sized dense
+        # draft cache while speeding nothing up — there speculation stays
+        # OFF unless the operator names a small DRAFT_MODEL explicitly.
+        draft_name = os.environ.get(
+            "DRAFT_MODEL", "tiny" if name == "tiny" else None
+        )
+        if os.environ.get("SPEC", "1") != "0" and draft_name is not None:
+            # draft CONFIG resolves at startup (operator misconfiguration
+            # must fail fast, like MODEL does); real deployments restore
+            # the draft's checkpoint rather than random weights
+            if draft_name not in CONFIGS:
+                raise SystemExit(
+                    f"DRAFT_MODEL={draft_name!r} unknown "
+                    f"(choices: {', '.join(CONFIGS)})"
+                )
+            draft_cfg = CONFIGS[draft_name]
+            if draft_cfg.vocab_size != self.cfg.vocab_size:
+                raise SystemExit(
+                    f"draft model '{draft_name}' has vocab_size "
+                    f"{draft_cfg.vocab_size} != target "
+                    f"{self.cfg.vocab_size} — a draft must share the "
+                    f"target's vocabulary"
+                )
+            draft_params = tfm.init_params(draft_cfg, jax.random.PRNGKey(1))
         self.engine = InferenceEngine(
             params,
             self.cfg,
             max_slots=int(os.environ.get("MAX_SLOTS", 8)),
             chunk_max=int(os.environ.get("CHUNK_MAX", 8)),
+            draft_params=draft_params,
+            draft_cfg=draft_cfg,
+            spec_k=self.spec_k,
         ).start()
-        # lazy draft model for /generate_speculative (DRAFT_MODEL env).
-        # Bypasses the engine, so concurrency is bounded separately: each
-        # in-flight speculative request holds its OWN dense target+draft
-        # caches — unbounded threads would OOM HBM where /generate is
-        # capped by max_slots.
-        import threading
 
-        # draft CONFIG resolves at startup (operator misconfiguration must
-        # fail fast, like MODEL does); params init stays lazy
-        draft_name = os.environ.get("DRAFT_MODEL", "tiny")
-        if draft_name not in CONFIGS:
-            raise SystemExit(
-                f"DRAFT_MODEL={draft_name!r} unknown "
-                f"(choices: {', '.join(CONFIGS)})"
+    def generate_speculative(self, prompt_ids, max_new_tokens, k=None):
+        """Greedy generation through the ENGINE's speculative path
+        (lossless vs /generate at temperature 0). Returns (tokens,
+        engine-cumulative speculation stats)."""
+        if self.engine.draft_params is None:
+            raise SpecDisabled(
+                "speculative decoding disabled (SPEC=0, or no DRAFT_MODEL "
+                "configured for a non-tiny MODEL)"
             )
-        self._draft_cfg = CONFIGS[draft_name]
-        if self._draft_cfg.vocab_size != self.cfg.vocab_size:
-            raise SystemExit(
-                f"draft model '{draft_name}' has vocab_size "
-                f"{self._draft_cfg.vocab_size} != target "
-                f"{self.cfg.vocab_size} — a draft must share the target's "
-                f"vocabulary"
-            )
-        self._draft = None
-        self._draft_lock = threading.Lock()
-        self._spec_slots = threading.Semaphore(
-            int(os.environ.get("SPEC_CONCURRENCY", 2))
-        )
-        # dense-cache budget for speculative requests (the engine's
-        # max_len bounds /generate the same way)
-        self.spec_max_len = int(os.environ.get("SPEC_MAX_LEN", 1024))
-
-    def _draft_model(self):
-        with self._draft_lock:  # racing first requests must not init twice
-            if self._draft is None:
-                self._draft = tfm.init_params(
-                    self._draft_cfg, jax.random.PRNGKey(1)
+        if k is not None:
+            if not 1 <= k <= 16:
+                # preserved bound from the standalone endpoint: k is
+                # compile-shaping, so unbounded values are a cache DoS
+                raise ValueError(f"k must be in [1, 16], got {k}")
+            if k != self.spec_k:
+                raise ValueError(
+                    f"k is engine-level (one compiled draft/verify round "
+                    f"per engine): this server runs SPEC_K={self.spec_k}; "
+                    f"omit k or pass {self.spec_k}"
                 )
-            return self._draft, self._draft_cfg
-
-    def generate_speculative(self, prompt_ids, max_new_tokens, k=4):
-        """Greedy speculative decoding (lossless vs target-only greedy):
-        the draft proposes k tokens/round, the target verifies them in
-        one decode_block dispatch. Returns (tokens, stats dict)."""
-        import jax.numpy as jnp
-        import numpy as np
-
-        from devspace_tpu.inference import generate_speculative
-
-        if not 1 <= k <= 16:
-            # k is a jit-static arg: every distinct value compiles its own
-            # draft scan, so an unbounded client-chosen k is also a
-            # compile-cache DoS
-            raise ValueError(f"k must be in [1, 16], got {k}")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt_ids) + max_new_tokens + k + 2 > self.spec_max_len:
-            raise ValueError(
-                f"prompt ({len(prompt_ids)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds SPEC_MAX_LEN={self.spec_max_len}"
+        req = self.engine.submit(prompt_ids, max_new_tokens)
+        tokens = req.result(timeout=600)
+        st = self.engine.stats()
+        return tokens, {
+            # engine-cumulative (slots interleave; per-request attribution
+            # would need per-slot counters): enough to see speculation work
+            "rounds": st["spec_rounds"],
+            "acceptance_rate": st["spec_acceptance"],
+            "tokens_per_round": round(
+                st["spec_committed"] / st["spec_rounds"], 2
             )
-        draft_params, draft_cfg = self._draft_model()
-        with self._spec_slots:
-            out, stats = generate_speculative(
-                self.params,
-                draft_params,
-                jnp.asarray([prompt_ids], jnp.int32),
-                self.cfg,
-                draft_cfg,
-                max_new_tokens,
-                k=k,
-            )
-        return np.asarray(out[0]).tolist(), {
-            "rounds": stats.rounds,
-            "acceptance_rate": round(stats.acceptance_rate, 3),
-            "tokens_per_round": round(stats.tokens_per_round, 2),
+            if st["spec_rounds"]
+            else 0.0,
         }
 
     def generate(
@@ -166,8 +167,9 @@ def main():
 
         def do_POST(self):
             if self.path == "/generate_speculative":
-                # greedy-only draft/verify decoding; lossless vs /generate
-                # at temperature 0 (devspace_tpu.inference.speculative).
+                # greedy-only draft/verify decoding THROUGH the engine's
+                # paged speculative path; lossless vs /generate at
+                # temperature 0 (devspace_tpu.inference.engine).
                 # Sampling/eos fields are REJECTED, not ignored — silently
                 # dropping them would break the losslessness contract.
                 try:
@@ -197,11 +199,17 @@ def main():
                     toks, stats = server.generate_speculative(
                         req["prompt_ids"],
                         int(req.get("max_new_tokens", 16)),
-                        k=int(req.get("k", 4)),
+                        k=(int(req["k"]) if "k" in req else None),
                     )
                     self._json(200, {"tokens": toks, "speculative": stats})
-                except Exception as e:  # noqa: BLE001
+                except SpecDisabled as e:
+                    self._json(501, {"error": str(e)})
+                except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+                    # client-input errors only — internal faults must not
+                    # masquerade as 400s or leak details (ADVICE r3)
                     self._json(400, {"error": str(e)})
+                except Exception:  # noqa: BLE001
+                    self._json(500, {"error": "internal server error"})
                 return
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
@@ -248,8 +256,10 @@ def main():
                     return
                 tokens = server.generate(prompt, n, **kwargs)
                 self._json(200, {"tokens": tokens})
-            except Exception as e:  # noqa: BLE001
+            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
                 self._json(400, {"error": str(e)})
+            except Exception:  # noqa: BLE001
+                self._json(500, {"error": "internal server error"})
 
     print("serving on :8000")
     http.server.ThreadingHTTPServer(("0.0.0.0", 8000), Handler).serve_forever()

@@ -529,6 +529,217 @@ def test_decode_block_matches_sequential_decode(params):
     )
 
 
+def test_decode_block_paged_matches_sequential_paged_decode(params):
+    """decode_block_paged (K tokens, one dispatch, paged pool) must equal
+    K sequential decode_tokens_paged calls — same logits, same pool."""
+    b, t0, kk, bs, mb = 2, 5, 3, 8, 8
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(1, CFG.vocab_size, (b, t0)),
+        jnp.int32,
+    )
+    pool = tfm.init_paged_pool(CFG, 1 + b * mb, bs)
+    tables = jnp.asarray(
+        [[1 + i * mb + j for j in range(mb)] for i in range(b)], jnp.int32
+    )
+    for i in range(b):
+        _, pool = tfm.prefill_chunk_paged(
+            params, pool, tables[i], prompt[i], jnp.asarray(0, jnp.int32), CFG
+        )
+    toks = jnp.asarray([[7, 3, 9], [1, 4, 2]], jnp.int32)
+    positions = t0 + jnp.tile(jnp.arange(kk), (b, 1))
+
+    blk_logits, blk_pool = tfm.decode_block_paged(
+        params, pool, tables, toks, positions, CFG
+    )
+    seq_pool, seq_logits = pool, []
+    for j in range(kk):
+        lg, seq_pool = tfm.decode_tokens_paged(
+            params, seq_pool, tables, toks[:, j], positions[:, j], CFG
+        )
+        seq_logits.append(lg)
+    np.testing.assert_allclose(
+        np.asarray(blk_logits),
+        np.asarray(jnp.stack(seq_logits, axis=1)),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(blk_pool["k"]), np.asarray(seq_pool["k"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(blk_pool["v"]), np.asarray(seq_pool["v"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_engine_speculative_matches_generate(params):
+    """ENGINE-level speculative decoding (draft proposals verified against
+    the paged pool) must stay greedy-lossless through queuing, slot reuse
+    and mixed request lengths — with an UNRELATED draft, whose proposals
+    are mostly rejected."""
+    other = tfm.init_params(CFG, jax.random.PRNGKey(123))
+    rng = np.random.default_rng(3)
+    requests = [
+        (list(rng.integers(1, CFG.vocab_size, size=plen)), n)
+        for plen, n in [(3, 8), (7, 5), (1, 10), (12, 4), (5, 6)]
+    ]
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64,
+        draft_params=other, draft_cfg=CFG, spec_k=4,
+    ).start()
+    try:
+        handles = [engine.submit(p, n) for p, n in requests]
+        results = [h.result(timeout=300) for h in handles]
+        st = engine.stats()
+    finally:
+        engine.stop()
+    for (prompt, n), got in zip(requests, results):
+        assert got == reference_generate(params, prompt, n), (
+            f"prompt len {len(prompt)} diverged with spec on"
+        )
+    assert st["spec_rounds"] > 0 and st["spec_committed"] > 0
+
+
+def test_engine_speculative_acceptance_with_matching_draft(params):
+    """With draft == target, proposals should almost always be accepted
+    (>= ~90%) even with multiple slots speccing concurrently — the
+    regression guard for the parked-slot draft-cache corruption, where a
+    spec round in the same iteration as a peer's draft prefill poisoned
+    the freshly-seeded row and collapsed acceptance to ~0."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64,
+        draft_params=params, draft_cfg=CFG, spec_k=3,
+    ).start()
+    try:
+        reqs = [([5, 1, 4], 12), ([2, 9, 9], 12), ([7, 3], 10)]
+        handles = [engine.submit(p, n) for p, n in reqs]
+        for (p, n), h in zip(reqs, handles):
+            assert h.result(timeout=300) == reference_generate(params, p, n)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert st["spec_acceptance"] > 0.8, st
+    # committed more tokens than rounds * 1 (speedup actually happened)
+    assert st["spec_committed"] > 2 * st["spec_rounds"]
+
+
+def test_engine_speculative_with_preemption(params):
+    """Speculative decoding must coexist with pool preemption: an
+    oversubscribed pool preempts/resumes requests mid-generation, the
+    resumed slot re-prefills BOTH models, and every result stays exact."""
+    p1, p2 = [2, 3, 4, 5], [9, 8, 7]
+    # 30 (not 40) new tokens: past ~38 this TINY/seed-0 trajectory hits an
+    # EXACT logit tie (two float32 logits identical to the bit), where
+    # differently-compiled graphs legitimately tie-break differently —
+    # the documented bitwise-equality caveat, not a spec-decoding bug.
+    # The pool (6 usable blocks, 5 needed per sequence) still guarantees
+    # contention between the co-resident sequences.
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=48,
+        block_size=8, n_blocks=7, prefill_chunk=8,
+        draft_params=params, draft_cfg=CFG, spec_k=3,
+    ).start()
+    try:
+        h1 = engine.submit(p1, 30)
+        h2 = engine.submit(p2, 30)
+        r1 = h1.result(timeout=600)
+        r2 = h2.result(timeout=600)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert r1 == reference_generate(params, p1, 30)
+    assert r2 == reference_generate(params, p2, 30)
+    assert st["requests_preempted"] >= 1
+    assert st["free_blocks"] == st["total_blocks"], "leaked blocks"
+
+
+def test_engine_speculative_mixed_sampling_and_boundary(params):
+    """Sampled requests bypass speculation (plain decode path in the same
+    iteration — no starvation), and a greedy request whose generation
+    crosses the spec-eligibility boundary (length + k > max_len) finishes
+    on the plain path, still exact."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=3, max_len=32,
+        draft_params=params, draft_cfg=CFG, spec_k=4,
+    ).start()
+    try:
+        # 20 prompt + 12 new = 32 = max_len: the tail tokens are
+        # ineligible for spec (would need coverage past max_len)
+        prompt = list(np.random.default_rng(5).integers(1, 200, size=20))
+        h_edge = engine.submit(prompt, 12)
+        h_greedy = engine.submit([5, 1, 4], 10)
+        h_sampled = engine.submit([4, 8], 10, temperature=0.8, seed=7)
+        assert h_edge.result(timeout=300) == reference_generate(params, prompt, 12)
+        assert h_greedy.result(timeout=300) == reference_generate(
+            params, [5, 1, 4], 10
+        )
+        toks = h_sampled.result(timeout=300)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert len(toks) == 10 and all(0 <= t < CFG.vocab_size for t in toks)
+    assert st["requests_completed"] == 3 and st["requests_failed"] == 0
+    assert st["spec_rounds"] > 0
+
+
+def test_engine_speculative_tensor_parallel(params):
+    """Spec decoding under the TP mesh: draft params are sharded like the
+    target, the draft cache shards over KV heads, and the whole spec
+    round runs under GSPMD — outputs still exactly match."""
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, mesh=mesh,
+        draft_params=params, draft_cfg=CFG, spec_k=3,
+    ).start()
+    try:
+        reqs = [([5, 1, 4], 7), ([2, 2, 2, 2, 2], 5)]
+        handles = [engine.submit(p, n) for p, n in reqs]
+        results = [h.result(timeout=300) for h in handles]
+        st = engine.stats()
+    finally:
+        engine.stop()
+    for (prompt, n), got in zip(reqs, results):
+        assert got == reference_generate(params, prompt, n)
+    assert st["spec_rounds"] > 0
+
+
+def test_engine_speculative_validation(params):
+    with pytest.raises(ValueError, match="draft_cfg"):
+        InferenceEngine(params, CFG, draft_params=params)
+    with pytest.raises(ValueError, match="spec_k"):
+        InferenceEngine(
+            params, CFG, draft_params=params, draft_cfg=CFG, spec_k=0
+        )
+
+
+def test_speculative_cache_horizon_covers_frozen_overrun(params):
+    """ADVICE r3: the standalone module's cache horizon must cover the
+    max write position of a FROZEN sequence (t_prompt + max_new + 2k - 1)
+    so correctness never rests on JAX dropping out-of-bounds scatters."""
+    from unittest import mock
+
+    from devspace_tpu.inference import speculative
+
+    captured = []
+    real_init = tfm.init_kv_cache
+
+    def spy(cfg, batch, max_len=None):
+        captured.append(max_len)
+        return real_init(cfg, batch, max_len)
+
+    prompt = jnp.asarray([[5, 1, 4], [2, 9, 9]], jnp.int32)
+    n_new, k = 6, 4
+    with mock.patch.object(speculative.tfm, "init_kv_cache", side_effect=spy):
+        speculative.generate_speculative(
+            params, params, prompt, CFG, CFG, n_new, k=k
+        )
+    t_prompt = prompt.shape[1]
+    assert captured and all(
+        h >= t_prompt + n_new + 2 * k for h in captured
+    ), captured
+
+
 def test_speculative_greedy_losslessness(params):
     """Greedy speculative decoding must produce EXACTLY the target
     model's greedy output, whatever the draft proposes — with a same-

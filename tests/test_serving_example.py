@@ -38,7 +38,7 @@ def test_serving_example_http_end_to_end():
         JAX_PLATFORMS="cpu",
         MODEL="tiny",
         MAX_SLOTS="2",
-        SPEC_CONCURRENCY="1",
+        SPEC_K="2",
     )
     proc = subprocess.Popen(
         [sys.executable, SERVE],
@@ -71,7 +71,8 @@ def test_serving_example_http_end_to_end():
         )
         assert code == 200 and len(g["tokens"]) == 6
 
-        # speculative: lossless vs /generate, stats present
+        # speculative THROUGH the engine: lossless vs /generate, engine
+        # speculation stats present
         code, s = _post(
             base + "/generate_speculative",
             {"prompt_ids": [5, 1, 4], "max_new_tokens": 6, "k": 2},
@@ -79,6 +80,14 @@ def test_serving_example_http_end_to_end():
         assert code == 200
         assert s["tokens"] == g["tokens"]
         assert s["speculative"]["rounds"] >= 1
+
+        # k is engine-level: an in-range k that differs from SPEC_K is
+        # rejected with guidance, not silently reinterpreted
+        code, err = _post(
+            base + "/generate_speculative",
+            {"prompt_ids": [1], "max_new_tokens": 4, "k": 3},
+        )
+        assert code == 400 and "SPEC_K" in err["error"]
 
         # sampling/eos/stream fields are rejected by PRESENCE, not value
         code, err = _post(
@@ -93,11 +102,13 @@ def test_serving_example_http_end_to_end():
         assert code == 400 and "greedy-only" in err["error"]
         # resource bounds: oversized horizon and out-of-range k error
         # cleanly instead of allocating
+        # resource bound is the ENGINE's max_len now (one bound for both
+        # endpoints), enforced before any allocation
         code, err = _post(
             base + "/generate_speculative",
             {"prompt_ids": [1], "max_new_tokens": 10**8},
         )
-        assert code == 400 and "SPEC_MAX_LEN" in err["error"]
+        assert code == 400 and "max_len" in err["error"]
         code, err = _post(
             base + "/generate_speculative",
             {"prompt_ids": [1], "max_new_tokens": 4, "k": 99},
