@@ -221,6 +221,10 @@ class InferenceEngine:
         self.prefill_chunk = max(1, int(prefill_chunk))
         L, Hkv, D = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         pool_sharding = None
+        # under a mesh, the paged-attention kernel is shard_mapped over
+        # the model axis (each shard streams its LOCAL KV heads) instead
+        # of letting GSPMD guess at pallas_call's partitioning
+        self._tp = (mesh, model_axis) if mesh is not None else None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -360,7 +364,7 @@ class InferenceEngine:
             def step(carry, _):
                 pool, tok, pos, keys = carry
                 logits, pool = tfm.decode_tokens_paged(
-                    params, pool, tables, tok, pos, cfg
+                    params, pool, tables, tok, pos, cfg, tp=self._tp
                 )
                 split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
                 keys, subs = split[:, 0], split[:, 1]
@@ -435,7 +439,7 @@ class InferenceEngine:
                     + jnp.arange(k_spec + 1, dtype=jnp.int32)[None]
                 )
                 logits, pool = tfm.decode_block_paged(
-                    t_params, pool, tables, block, positions, cfg
+                    t_params, pool, tables, block, positions, cfg, tp=self._tp
                 )
                 choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return pool, d_cache, props, choices

@@ -386,12 +386,15 @@ def decode_tokens_paged(
     tokens: jax.Array,  # [B] int32
     positions: jax.Array,  # [B] int32 logical write position per sequence
     cfg: TransformerConfig,
+    tp=None,  # (mesh, axis_name): shard the kernel over local KV heads
 ) -> tuple[jax.Array, dict]:
     """``decode_tokens`` over a paged pool: identical math, but K/V reads
     come straight from each slot's blocks (Pallas paged-attention kernel
     on TPU — no gather materialization; jnp gather reference elsewhere,
     ops/paged_attention.py) and the new token's K/V scatters into
-    (table[pos // bs], pos % bs)."""
+    (table[pos // bs], pos % bs). ``tp`` pins the kernel's head
+    partitioning under a tensor-parallel mesh (see
+    ops.paged_attention.paged_decode_attention)."""
     from ..ops.paged_attention import paged_decode_attention
 
     b = tokens.shape[0]
@@ -420,7 +423,7 @@ def decode_tokens_paged(
         new_k.append(k_pool)
         new_v.append(v_pool)
         ctx = paged_decode_attention(
-            q[:, 0], k_pool, v_pool, tables, lengths
+            q[:, 0], k_pool, v_pool, tables, lengths, tp=tp
         )  # [B, H, D]
         h = h + (ctx.reshape(b, 1, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
@@ -570,6 +573,7 @@ def decode_block_paged(
     tokens: jax.Array,  # [B, K] int32 token block per sequence
     positions: jax.Array,  # [B, K] int32 write positions (consecutive)
     cfg: TransformerConfig,
+    tp=None,  # (mesh, axis_name): shard the kernel over local KV heads
 ) -> tuple[jax.Array, dict]:
     """K-token generalization of ``decode_tokens_paged`` -> (logits
     [B, K, vocab], pool') — the verification forward for ENGINE-level
@@ -628,6 +632,7 @@ def decode_block_paged(
             v_pool,
             tables_flat,
             lengths,
+            tp=tp,
         )  # [B*K, H, D]
         h = h + (ctx.reshape(b, kk, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)

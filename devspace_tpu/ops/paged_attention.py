@@ -149,10 +149,45 @@ def _paged_decode_pallas(q, pool_k, pool_v, tables, lengths):
     return out.reshape(b, h, d)
 
 
-def paged_decode_attention(q, pool_k, pool_v, tables, lengths):
+# Last dispatch decision, recorded at TRACE time — lets tests and the
+# driver's dryrun assert WHICH path a jitted computation actually took
+# (a silent fallback to the gather reference under a mesh is exactly the
+# regression this guards against).
+LAST_DISPATCH = {"impl": None, "tp": False}
+
+
+def paged_decode_attention(q, pool_k, pool_v, tables, lengths, tp=None):
     """One decode step of paged attention: q [B, H, D] against each
     slot's pooled cache -> ctx [B, H, D]. Pallas on TPU (no gather
-    materialization), jnp reference elsewhere."""
-    if use_pallas():
-        return _paged_decode_pallas(q, pool_k, pool_v, tables, lengths)
-    return paged_decode_reference(q, pool_k, pool_v, tables, lengths)
+    materialization), jnp reference elsewhere.
+
+    ``tp=(mesh, axis_name)`` runs the kernel UNDER tensor parallelism:
+    a ``jax.shard_map`` over the mesh partitions q and the K/V pools on
+    their head dim, so each shard streams only its LOCAL KV heads
+    through the Pallas kernel (tables/lengths replicated). Attention is
+    head-parallel — no collectives; the surrounding decode's ``wo``
+    matmul reduces across shards via GSPMD as before. Without this,
+    ``pallas_call`` under GSPMD would see GLOBAL-shape operands and
+    either gather them per-device or fail to partition — the shard_map
+    pins the partitioning the kernel's grid assumes."""
+    pallas = use_pallas()
+    impl = _paged_decode_pallas if pallas else paged_decode_reference
+    LAST_DISPATCH["impl"] = "pallas" if pallas else "reference"
+    LAST_DISPATCH["tp"] = tp is not None
+    if tp is None:
+        return impl(q, pool_k, pool_v, tables, lengths)
+    mesh, axis = tp
+    from jax.sharding import PartitionSpec as P
+
+    head_sharded = P(None, None, axis, None)  # pools [N, bs, Hkv, D]
+    return jax.shard_map(
+        impl,
+        mesh=mesh,
+        in_specs=(P(None, axis, None), head_sharded, head_sharded,
+                  P(None, None), P(None)),
+        out_specs=P(None, axis, None),
+        # pallas_call's out_shape carries no varying-mesh-axes metadata,
+        # which trips shard_map's vma check; the body is collective-free
+        # (head-parallel), so the check adds nothing here
+        check_vma=False,
+    )(q, pool_k, pool_v, tables, lengths)

@@ -681,12 +681,19 @@ def test_engine_speculative_mixed_sampling_and_boundary(params):
     assert st["spec_rounds"] > 0
 
 
-def test_engine_speculative_tensor_parallel(params):
+def test_engine_speculative_tensor_parallel(params, monkeypatch):
     """Spec decoding under the TP mesh: draft params are sharded like the
     target, the draft cache shards over KV heads, and the whole spec
-    round runs under GSPMD — outputs still exactly match."""
+    round runs under GSPMD — outputs still exactly match. Forces the
+    PALLAS paged kernel (interpret mode) so the shard_mapped block-verify
+    path (decode_block_paged with flattened [B*K] queries) is exercised,
+    and asserts via LAST_DISPATCH that it didn't silently fall back to
+    the gather reference."""
+    from devspace_tpu.ops import paged_attention as pa
     from devspace_tpu.parallel.mesh import create_mesh
 
+    monkeypatch.setenv("DEVSPACE_PALLAS", "1")
+    monkeypatch.setenv("DEVSPACE_PALLAS_INTERPRET", "1")
     mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
     engine = InferenceEngine(
         params, CFG, max_slots=2, max_len=64, mesh=mesh,
@@ -702,6 +709,7 @@ def test_engine_speculative_tensor_parallel(params):
     for (prompt, n), got in zip(reqs, results):
         assert got == reference_generate(params, prompt, n)
     assert st["spec_rounds"] > 0
+    assert pa.LAST_DISPATCH == {"impl": "pallas", "tp": True}
 
 
 def test_engine_speculative_validation(params):

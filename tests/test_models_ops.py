@@ -511,3 +511,37 @@ def test_paged_decode_attention_kernel_matches_reference(pallas_interpret):
         np.asarray(got[:3]), np.asarray(ref[:3]), rtol=2e-4, atol=2e-5
     )
     assert bool(jnp.all(got[3] == 0.0))
+
+
+def test_paged_decode_attention_under_tp_mesh(pallas_interpret, monkeypatch):
+    """VERDICT r3 next #3: the paged-attention kernel shard_mapped over
+    the model axis — each shard runs the PALLAS kernel (interpret mode)
+    on its local KV heads — must match the unsharded gather reference,
+    and LAST_DISPATCH must prove no silent fallback."""
+    from devspace_tpu.ops import paged_attention as pa
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    monkeypatch.setenv("DEVSPACE_PALLAS", "1")
+    rng = np.random.default_rng(1)
+    B, H, Hkv, D = 4, 8, 4, 16
+    n_blocks, bs, MB = 9, 8, 3
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pool_k = jnp.asarray(
+        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+    )
+    pool_v = jnp.asarray(
+        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+    )
+    tables = jnp.asarray(
+        rng.integers(0, n_blocks, size=(B, MB)), dtype=jnp.int32
+    )
+    lengths = jnp.asarray([MB * bs, bs + 3, 1, 5], dtype=jnp.int32)
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    got = jax.jit(
+        lambda *a: pa.paged_decode_attention(*a, tp=(mesh, "model"))
+    )(q, pool_k, pool_v, tables, lengths)
+    assert pa.LAST_DISPATCH == {"impl": "pallas", "tp": True}
+    ref = pa.paged_decode_reference(q, pool_k, pool_v, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
