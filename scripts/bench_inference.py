@@ -39,34 +39,75 @@ N_REQ = 8
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", 64))
 # which phases to run (comma list); smoke runs can pick one
 PHASES = set(
-    os.environ.get("BENCH_PHASES", "serial,engine,admission,pressure").split(",")
+    os.environ.get(
+        "BENCH_PHASES", "serial,engine,spec,admission,pressure"
+    ).split(",")
+)
+
+# What the latency stats time (VERDICT r3 next #7): the engine's chunked
+# decode delivers up to chunk_max tokens per dispatch, so CLIENT-VISIBLE
+# progress happens in bursts — gaps between individual tokens inside one
+# burst are ~0 and reporting their p50 as "inter-token latency" was a
+# measurement artifact. The honest number is the gap between successive
+# burst ARRIVALS at the client read boundary, reported next to the mean
+# burst size (tokens per arrival).
+TIMED_NOTE = (
+    "gaps between client-visible burst arrivals (chunked decode delivers "
+    "up to chunk_max tokens per dispatch); mean_tokens_per_arrival gives "
+    "the burst size"
 )
 
 
-def _gap_stats(gaps: list) -> dict:
-    """p50/p95/max (ms) of inter-token gaps — one implementation for the
-    admission and pressure phases."""
-    gaps = sorted(gaps)
+def _arrival_stats(arrivals: list) -> dict:
+    """p50/p95/max (ms) of inter-ARRIVAL gaps + mean burst size, from
+    [(timestamp, n_tokens), ...] — one implementation for the admission
+    and pressure phases."""
+    gaps = sorted(
+        b[0] - a[0] for a, b in zip(arrivals, arrivals[1:])
+    )
+    n_tokens = sum(n for _, n in arrivals)
     if not gaps:
-        return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+        return {
+            "p50": 0.0, "p95": 0.0, "max": 0.0,
+            "mean_tokens_per_arrival": float(n_tokens),
+            "timed": TIMED_NOTE,
+        }
     return {
         "p50": round(gaps[len(gaps) // 2] * 1000, 1),
         "p95": round(gaps[min(int(len(gaps) * 0.95), len(gaps) - 1)] * 1000, 1),
         "max": round(gaps[-1] * 1000, 1),
+        "mean_tokens_per_arrival": round(n_tokens / len(arrivals), 2),
+        "timed": TIMED_NOTE,
     }
 
 
-def _stream_gaps(handle, timeout: float, on_token=None) -> list:
-    """Consume a streaming request, timing gaps between tokens."""
-    gaps, last = [], None
-    for i, _ in enumerate(handle.stream(timeout=timeout)):
-        now = time.time()
-        if last is not None:
-            gaps.append(now - last)
-        last = now
-        if on_token is not None:
-            on_token(i)
-    return gaps
+def _stream_arrivals(handle, timeout: float, on_token=None) -> list:
+    """Drain a streaming request at the client read boundary: one
+    (timestamp, n_new_tokens) per non-empty read — the granularity a
+    real stream consumer observes. The progress deadline resets on every
+    arrival (a healthy long generation never times out)."""
+    arrivals = []
+    sent = 0
+    deadline = time.monotonic() + timeout
+    while True:
+        n = len(handle.tokens)  # list append is atomic under the GIL
+        if n > sent:
+            now = time.monotonic()
+            arrivals.append((now, n - sent))
+            if on_token is not None:
+                for i in range(sent, n):
+                    on_token(i)
+            sent = n
+            deadline = now + timeout
+        elif handle.done.is_set():
+            if len(handle.tokens) == sent:
+                handle.result(timeout=1)  # surface engine errors
+                return arrivals
+            # tail appended between the read and done: loop once more
+        elif time.monotonic() > deadline:
+            raise TimeoutError("stream stalled")
+        else:
+            handle.done.wait(0.0005)
 
 
 def main():
@@ -98,32 +139,96 @@ def main():
             file=sys.stderr,
         )
 
+    def timed_wave(engine):
+        """Warmup/compile wave at FULL length (short warmups would leave
+        the larger chunk kernels to compile inside the timed window),
+        then the timed wave. Returns (seconds, stats-delta dict)."""
+        try:
+            for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
+                h.result(timeout=600)
+            before = engine.stats()
+            t0 = time.time()
+            for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
+                h.result(timeout=600)
+            elapsed = time.time() - t0
+            delta = {
+                k: v - before[k]
+                for k, v in engine.stats().items()
+                if isinstance(v, int) and isinstance(before.get(k), int)
+            }
+        finally:
+            engine.stop()
+        return elapsed, delta
+
     # engine: all 8 in flight
     engine_s = None
     if "engine" in PHASES:
-        engine = InferenceEngine(
-            params,
-            CFG,
-            max_slots=N_REQ,
-            max_len=256,
-            chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
-        ).start()
-        try:
-            # warmup/compile wave at FULL length — short warmups would leave
-            # the larger chunk kernels to compile inside the timed window
-            for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
-                h.result(timeout=600)
-            t0 = time.time()
-            handles = [engine.submit(p, NEW_TOKENS) for p in prompts]
-            for h in handles:
-                h.result(timeout=600)
-            engine_s = time.time() - t0
-        finally:
-            engine.stop()
+        engine_s, _ = timed_wave(
+            InferenceEngine(
+                params,
+                CFG,
+                max_slots=N_REQ,
+                max_len=256,
+                chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+            ).start()
+        )
         ratio = f" -> {serial_s / engine_s:.2f}x serial" if serial_s else ""
         print(
             f"[inf-bench] continuous batching: {total_new / engine_s:.1f} tok/s "
             f"({engine_s:.2f}s){ratio}",
+            file=sys.stderr,
+        )
+
+    # speculative decoding under concurrent load (VERDICT r3 next #2):
+    # the same request wave through the engine's spec path, reporting
+    # tok/s against the plain engine phase plus measured acceptance.
+    # The draft is the TARGET's own weights (self-draft): with random
+    # bench weights any real small draft would have ~0 acceptance, so
+    # this measures the MECHANISM at its acceptance ceiling and the
+    # verify-block economics — a trained small draft is what turns the
+    # high acceptance into a net speedup.
+    spec = None
+    if "spec" in PHASES:
+        spec_s, st = timed_wave(
+            InferenceEngine(
+                params,
+                CFG,
+                max_slots=N_REQ,
+                max_len=256,
+                chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+                draft_params=params,
+                draft_cfg=CFG,
+                spec_k=int(os.environ.get("BENCH_SPEC_K", 4)),
+            ).start()
+        )
+        # st holds TIMED-WAVE deltas (the compile wave runs the same
+        # workload and would otherwise dilute the per-round figures)
+        spec = {
+            "tok_per_sec": round(total_new / spec_s, 1),
+            "vs_plain_engine": round(engine_s / spec_s, 2) if engine_s else None,
+            "spec_k": int(os.environ.get("BENCH_SPEC_K", 4)),
+            "acceptance": round(st["spec_accepted"] / st["spec_proposed"], 4)
+            if st["spec_proposed"]
+            else 0.0,
+            "rounds": st["spec_rounds"],
+            "committed_per_round_all_slots": round(
+                st["spec_committed"] / st["spec_rounds"], 2
+            )
+            if st["spec_rounds"]
+            else 0.0,
+            "note": "self-draft (target weights): acceptance ceiling + "
+            "verify economics, not a trained-small-draft speedup",
+        }
+        vs = (
+            f" ({spec['vs_plain_engine']}x plain engine)"
+            if spec["vs_plain_engine"]
+            else ""
+        )
+        print(
+            f"[inf-bench] speculative (self-draft, k={spec['spec_k']}): "
+            f"{spec['tok_per_sec']} tok/s{vs}, acceptance "
+            f"{spec['acceptance']}, {spec['committed_per_round_all_slots']} "
+            f"tok/round (all slots)",
             file=sys.stderr,
         )
 
@@ -154,14 +259,26 @@ def main():
             def admit(i):
                 if not admitted and i >= 8:
                     engine.submit(long_prompt, 8)  # admit mid-stream
-                    admitted.append(True)
+                    admitted.append(time.monotonic())
 
-            gaps = _stream_gaps(stream_req, timeout=600, on_token=admit)
-            admission_stats = _gap_stats(gaps[8:])
+            arrivals = _stream_arrivals(stream_req, timeout=600, on_token=admit)
+            # stats cover the window where the long prompt's chunked
+            # prefill competes with the stream's decode — INCLUDING the
+            # last pre-admission arrival, so the first contended gap
+            # (which absorbs the first competing prefill chunk, typically
+            # the largest stall) is measured
+            if admitted:
+                contended = [a for a in arrivals if a[0] >= admitted[0]]
+                head = [a for a in arrivals if a[0] < admitted[0]]
+                if head:
+                    contended.insert(0, head[-1])
+            else:
+                contended = []
+            admission_stats = _arrival_stats(contended)
         finally:
             engine.stop()
         print(
-            f"[inf-bench] inter-token gap during long-prompt admission: "
+            f"[inf-bench] inter-arrival gap during long-prompt admission: "
             f"p50 {admission_stats['p50']}ms p95 {admission_stats['p95']}ms "
             f"max {admission_stats['max']}ms",
             file=sys.stderr,
@@ -183,7 +300,8 @@ def main():
         "serial_tok_per_sec": round(total_new / serial_s, 1)
         if serial_s
         else None,
-        "intertoken_during_admission_ms": admission_stats,
+        "interarrival_during_admission_ms": admission_stats,
+        "speculative": spec,
         "pressure": pressure,
         "config": {
             "dim": CFG.dim,
@@ -257,7 +375,7 @@ def _pressure_phase(params, rng) -> dict:
             engine.submit(list(rng.integers(1, 1000, size=p_prompt)), p_new)
             for _ in range(p_slots - 1)
         ]
-        pgaps = _stream_gaps(stream_h, timeout=1800)
+        parrivals = _stream_arrivals(stream_h, timeout=1800)
         for h in rest:
             h.result(timeout=1800)
         pressure_s = time.time() - t0
@@ -265,11 +383,11 @@ def _pressure_phase(params, rng) -> dict:
     finally:
         engine.stop()
     pressure_tok = p_slots * p_new
-    stats = _gap_stats(pgaps)
+    stats = _arrival_stats(parrivals)
     print(
         f"[inf-bench] under {oversubscription:.2f}x KV oversubscription: "
         f"{pressure_tok / pressure_s:.1f} tok/s, {preemptions} preemption(s), "
-        f"inter-token p50 {stats['p50']}ms p95 {stats['p95']}ms",
+        f"inter-arrival p50 {stats['p50']}ms p95 {stats['p95']}ms",
         file=sys.stderr,
     )
     if preemptions == 0:
@@ -286,7 +404,7 @@ def _pressure_phase(params, rng) -> dict:
         "new_tokens_each": p_new,
         "pool_blocks": p_blocks,
         "demand_blocks": demand_blocks,
-        "intertoken_ms": stats,
+        "interarrival_ms": stats,
     }
 
 
