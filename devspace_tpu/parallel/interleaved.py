@@ -16,16 +16,22 @@ the tables with the device's stage id — no data-dependent control flow,
 exactly like the non-interleaved schedule in pipeline.py, just
 table-driven instead of closed-form.
 
-The builder is a greedy earliest-tick list scheduler under the real
-constraints (F needs the upstream activation a tick earlier, B needs the
-downstream grad a tick earlier, one op per device per tick, in-order
-microbatches per virtual stage), with backward-first priority — running
-B as early as possible is what bounds in-flight activations (1F1B's
-memory property) — and Megatron's chunk-cycling forward order (groups of
-S microbatches per chunk) which is what realizes the V-fold bubble
-shrink. Buffer depths (activation stash per chunk, in-flight hops per
-edge) are derived from the schedule afterwards and become static array
-sizes in the executor.
+The builder generates Megatron's exact static per-device op order —
+warmup of ``2*(S-s-1) + (V-1)*S`` forwards on device s, then strict
+F,B,F,B 1F1B alternation, with chunk-cycling in groups of S
+microbatches (forward ascending chunks, backward descending) — and then
+TICK-SIMULATES it under the real lockstep constraints (F needs the
+upstream activation a tick earlier, B the downstream grad a tick
+earlier, one op per device per tick, in-order microbatches per virtual
+stage): each device executes the head of its queue when ready, else
+idles. The simulation realizes Megatron's bubble exactly: 2*(S-1)
+chunk-ticks total — V-fold smaller than non-interleaved 1F1B's
+2*(S-1)*V, i.e. a bubble fraction of (S-1)/(M*V + S-1) — asserted
+across an (S, V, M) grid in tests/test_parallel.py. (An earlier greedy
+backward-first list scheduler landed ~30-70% above this bound; the
+warmup depth is the part greedy choice cannot discover.) Buffer depths
+(activation stash per chunk, in-flight hops per edge) are derived from
+the schedule afterwards and become static array sizes in the executor.
 """
 
 from __future__ import annotations
@@ -62,6 +68,40 @@ class InterleavedSchedule:
         return 1.0 - busy / (self.total_ticks or 1)
 
 
+def _device_op_order(S: int, V: int, M: int, s: int) -> list:
+    """Megatron's static op sequence for device ``s``: warmup forwards,
+    then strict F,B alternation until forwards run out, then the
+    backward drain. Forward order cycles chunks in groups of S
+    microbatches ascending; backward mirrors it with chunks descending.
+    Microbatches stay in-order per virtual stage by construction (the
+    executor's ring/buffer slot math relies on it)."""
+    groups = [range(g0, min(g0 + S, M)) for g0 in range(0, M, S)]
+    fwd = [
+        (v, m) for grp in groups for v in range(V) for m in grp
+    ]
+    bwd = [
+        (v, m)
+        for grp in groups
+        for v in reversed(range(V))
+        for m in grp
+    ]
+    # Warmup depth is the schedule's load-bearing constant: deep enough
+    # that the steady state never starves (the first grad arrives just
+    # as warmup ends on every device), shallow enough that in-flight
+    # activations stay bounded.
+    warmup = min(2 * (S - s - 1) + (V - 1) * S, len(fwd))
+    queue = [(OP_F, v, m) for v, m in fwd[:warmup]]
+    fi, bi = warmup, 0
+    while fi < len(fwd) or bi < len(bwd):
+        if fi < len(fwd):
+            queue.append((OP_F, *fwd[fi]))
+            fi += 1
+        if bi < len(bwd):
+            queue.append((OP_B, *bwd[bi]))
+            bi += 1
+    return queue
+
+
 def build_interleaved_schedule(
     n_stages: int, n_chunks: int, n_micro: int
 ) -> InterleavedSchedule:
@@ -71,8 +111,6 @@ def build_interleaved_schedule(
     b_done: dict[tuple[int, int], int] = {}
 
     def f_ready(p: int, m: int, tau: int) -> bool:
-        if (p, m) in f_done:
-            return False
         if m > 0 and (p, m - 1) not in f_done:
             return False  # in-order per stage (buffer slots rely on it)
         if p > 0 and f_done.get((p - 1, m), tau) >= tau:
@@ -80,8 +118,6 @@ def build_interleaved_schedule(
         return True
 
     def b_ready(p: int, m: int, tau: int) -> bool:
-        if (p, m) in b_done:
-            return False
         if m > 0 and (p, m - 1) not in b_done:
             return False
         if p == P - 1:
@@ -93,52 +129,94 @@ def build_interleaved_schedule(
 
     ops: list[list[tuple[int, int, int]]] = []  # per tick: [(op,p,m)] per dev
     tau = 0
-    while len(f_done) + len(b_done) < 2 * P * M:
-        tick_ops: list[tuple[int, int, int]] = [(OP_IDLE, 0, 0)] * S
-        scheduled = False
-        for s in range(S):
-            best = None
-            # backward first (1F1B memory bound), earliest microbatch,
-            # deepest chunk (drain the far end before refilling)
-            b_cands = []
-            for v in range(V):
+    if M % S == 0:
+        # Megatron static order: realizes the exact 2*(S-1) bubble, but
+        # its warmup symmetry needs full chunk-cycling groups (S | M —
+        # Megatron-LM imposes the same divisibility requirement)
+        queues = [_device_op_order(S, V, M, s) for s in range(S)]
+        heads = [0] * S
+        while any(heads[s] < len(queues[s]) for s in range(S)):
+            tick_ops: list[tuple[int, int, int]] = [(OP_IDLE, 0, 0)] * S
+            # select against the PREVIOUS ticks' state for every device
+            # (readiness uses `>= tau`), then commit — ops chosen this
+            # tick cannot feed each other within the tick
+            for s in range(S):
+                if heads[s] >= len(queues[s]):
+                    continue
+                op, v, m = queues[s][heads[s]]
                 p = v * S + s
-                for m in range(M):
-                    if b_ready(p, m, tau):
-                        b_cands.append(((m, -v), (OP_B, p, m)))
-                        break
-            if b_cands:
-                best = min(b_cands)[1]
-            else:
-                # Megatron chunk-cycling forward order: groups of S
-                # microbatches per chunk, cycling chunks between groups
-                f_cands = []
+                ready = (
+                    f_ready(p, m, tau) if op == OP_F else b_ready(p, m, tau)
+                )
+                if ready:
+                    tick_ops[s] = (op, p, m)
+            scheduled = False
+            for s in range(S):
+                op, p, m = tick_ops[s]
+                if op == OP_F:
+                    f_done[(p, m)] = tau
+                elif op == OP_B:
+                    b_done[(p, m)] = tau
+                else:
+                    continue
+                heads[s] += 1
+                scheduled = True
+            if not scheduled:
+                # an all-idle tick can never recover (readiness depends
+                # only on ticks < tau): a genuine deadlock, which for
+                # the divisible static order would be a builder bug
+                raise RuntimeError(
+                    f"interleaved schedule deadlocked at tick {tau} "
+                    f"(S={S}, V={V}, M={M})"
+                )
+            ops.append(tick_ops)
+            tau += 1
+    else:
+        # ragged microbatch count: greedy earliest-tick list scheduler
+        # (backward-first with chunk-cycling forwards) — valid for ANY
+        # (S, V, M), lands within a few ticks of the bound
+        while len(f_done) + len(b_done) < 2 * P * M:
+            tick_ops = [(OP_IDLE, 0, 0)] * S
+            scheduled = False
+            for s in range(S):
+                best = None
+                b_cands = []
                 for v in range(V):
                     p = v * S + s
                     for m in range(M):
-                        if f_ready(p, m, tau):
-                            f_cands.append(((m // S, v, m), (OP_F, p, m)))
+                        if (p, m) not in b_done and b_ready(p, m, tau):
+                            b_cands.append(((m // S, -v, m), (OP_B, p, m)))
                             break
-                if f_cands:
-                    best = min(f_cands)[1]
-            if best is not None:
-                tick_ops[s] = best
-                scheduled = True
-        # commit AFTER selection: readiness used `>= tau`, so ops chosen
-        # this tick cannot feed each other within the tick
-        for s in range(S):
-            op, p, m = tick_ops[s]
-            if op == OP_F:
-                f_done[(p, m)] = tau
-            elif op == OP_B:
-                b_done[(p, m)] = tau
-        if not scheduled:
-            raise RuntimeError(
-                f"interleaved schedule deadlocked at tick {tau} "
-                f"(S={S}, V={V}, M={M})"
-            )
-        ops.append(tick_ops)
-        tau += 1
+                if b_cands:
+                    best = min(b_cands)[1]
+                else:
+                    f_cands = []
+                    for v in range(V):
+                        p = v * S + s
+                        for m in range(M):
+                            if (p, m) not in f_done and f_ready(p, m, tau):
+                                f_cands.append(
+                                    ((m // S, v, m), (OP_F, p, m))
+                                )
+                                break
+                    if f_cands:
+                        best = min(f_cands)[1]
+                if best is not None:
+                    tick_ops[s] = best
+                    scheduled = True
+            for s in range(S):
+                op, p, m = tick_ops[s]
+                if op == OP_F:
+                    f_done[(p, m)] = tau
+                elif op == OP_B:
+                    b_done[(p, m)] = tau
+            if not scheduled:
+                raise RuntimeError(
+                    f"interleaved schedule deadlocked at tick {tau} "
+                    f"(S={S}, V={V}, M={M})"
+                )
+            ops.append(tick_ops)
+            tau += 1
 
     total = len(ops)
     # activation-ring depth: max in-flight (F done, B pending) per stage

@@ -785,13 +785,62 @@ def make_pipeline_lm_train_step(
     (equivalence is asserted in tests/test_parallel.py). ``data_axis``/
     ``tp_axis`` compose pp with dp/tp on the same mesh (Megatron-style
     pp x dp x tp in one jitted program)."""
-    import optax
-    from jax.sharding import NamedSharding
-
     loss_and_grads = pipeline_lm_loss_and_grads(
         mesh, cfg, n_microbatches, axis=axis, data_axis=data_axis,
         tp_axis=tp_axis,
     )
+    return _pp_train_step(
+        loss_and_grads,
+        pipeline_param_specs(axis, tp_axis),
+        mesh,
+        optimizer,
+        data_axis=data_axis,
+        donate=donate,
+    )
+
+
+def make_interleaved_pipeline_lm_train_step(
+    mesh: Mesh,
+    cfg,
+    optimizer,
+    n_microbatches: int,
+    n_chunks: int,
+    axis: str = "pipe",
+    data_axis: str = None,
+    tp_axis: str = None,
+    donate: bool = True,
+):
+    """Interleaved (virtual-stage) 1F1B train step: ``step(state, tokens)
+    -> (state, loss)`` with state = {params (interleaved stage layout,
+    from transformer_interleaved_stage_params), opt_state, step} —
+    the full-step counterpart of ``make_pipeline_lm_train_step`` with a
+    ~V-fold smaller pipeline bubble (parallel/interleaved.py; the
+    schedule hits Megatron's 2*(S-1) chunk-tick bound when
+    n_microbatches is a multiple of the stage count). Optimizer moments
+    mirror the chunked stage layout and shard via
+    ``interleaved_param_specs``; the state is donated so params/moments
+    update in place."""
+    loss_and_grads = interleaved_pipeline_lm_loss_and_grads(
+        mesh, cfg, n_microbatches, n_chunks, axis=axis,
+        data_axis=data_axis, tp_axis=tp_axis,
+    )
+    return _pp_train_step(
+        loss_and_grads,
+        interleaved_param_specs(axis, tp_axis),
+        mesh,
+        optimizer,
+        data_axis=data_axis,
+        donate=donate,
+    )
+
+
+def _pp_train_step(
+    loss_and_grads, param_specs, mesh, optimizer, data_axis, donate
+):
+    """Shared train-step tail for both pipeline layouts: optimizer
+    update + lazily-built jit with sharded opt-state and donation."""
+    import optax
+    from jax.sharding import NamedSharding
 
     def step_fn(state, tokens):
         loss, grads = loss_and_grads(state["params"], tokens)
@@ -806,7 +855,6 @@ def make_pipeline_lm_train_step(
             "step": state["step"] + 1,
         }, loss
 
-    param_specs = pipeline_param_specs(axis, tp_axis)
     params_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
         param_specs,

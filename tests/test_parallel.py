@@ -797,6 +797,105 @@ def test_interleaved_schedule_properties():
         )
 
 
+def test_interleaved_schedule_hits_megatron_bubble_bound():
+    """VERDICT r3 next #4: with S | M (Megatron's own divisibility
+    requirement), the static-order schedule must realize EXACTLY the
+    Megatron interleaved bubble — 2*(S-1) chunk-ticks, V-fold below
+    non-interleaved 1F1B's 2*(S-1)*V — i.e. a bubble fraction of
+    (S-1)/(M*V + S-1), across an (S, V, M) grid."""
+    from devspace_tpu.parallel.interleaved import build_interleaved_schedule
+
+    grid = [
+        (2, 2, 4), (2, 2, 8), (4, 2, 8), (2, 4, 8), (4, 4, 8),
+        (2, 2, 2), (4, 2, 16), (3, 2, 6), (8, 2, 16), (2, 1, 4),
+        (4, 1, 8), (8, 4, 16), (2, 3, 6),
+    ]
+    for S, V, M in grid:
+        sched = build_interleaved_schedule(S, V, M)
+        busy = 2 * M * V
+        bubble_ticks = sched.total_ticks - busy
+        assert bubble_ticks == 2 * (S - 1), (
+            S, V, M, bubble_ticks, 2 * (S - 1)
+        )
+        expect_frac = (S - 1) / (M * V + S - 1)
+        assert abs(sched.bubble_fraction - expect_frac) < 1e-9
+    # ragged M (S does not divide M): the greedy fallback must still
+    # build a valid schedule for every combo (regression: the static
+    # order deadlocks on e.g. (8, 2, 10))
+    from devspace_tpu.parallel.interleaved import OP_B, OP_F
+
+    for S, V, M in [(8, 2, 10), (4, 3, 5), (3, 3, 7), (2, 2, 3)]:
+        sched = build_interleaved_schedule(S, V, M)
+        n_f = sum(
+            1
+            for t in range(sched.total_ticks)
+            for s in range(S)
+            if sched.op[t, s] == OP_F
+        )
+        n_b = sum(
+            1
+            for t in range(sched.total_ticks)
+            for s in range(S)
+            if sched.op[t, s] == OP_B
+        )
+        assert n_f == n_b == S * V * M, (S, V, M)
+
+
+def test_interleaved_train_step_reduces_loss_and_matches_reference():
+    """make_interleaved_pipeline_lm_train_step (VERDICT r3 next #4): the
+    full train step over the interleaved layout — sharded opt moments,
+    donation — must start from the SAME loss as the non-pipelined model
+    and train it down."""
+    import dataclasses
+
+    import optax
+
+    from devspace_tpu.models import transformer as tfm
+    from devspace_tpu.ops.losses import fused_cross_entropy
+    from devspace_tpu.parallel.mesh import create_mesh
+    from devspace_tpu.parallel.pipeline import (
+        make_interleaved_pipeline_lm_train_step,
+        transformer_interleaved_stage_params,
+    )
+
+    cfg = dataclasses.replace(tfm.TINY, dtype=jnp.float32, n_layers=4)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    S, V, M, mb, T = 2, 2, 4, 2, 16
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (M, mb, T + 1), 0, cfg.vocab_size
+    )
+    flat = tokens.reshape(M * mb, T + 1)
+
+    def loss_fn(p):
+        logits = tfm.forward(p, flat[:, :-1], cfg)
+        b, t, v = logits.shape
+        return jnp.mean(
+            fused_cross_entropy(
+                logits.reshape(b * t, v), flat[:, 1:].reshape(-1)
+            )
+        )
+
+    ref_loss = float(jax.jit(loss_fn)(params))
+
+    mesh = create_mesh({"pipe": S}, devices=jax.devices()[:S])
+    staged = transformer_interleaved_stage_params(params, S, V)
+    opt = optax.adam(5e-3)
+    state = {
+        "params": staged,
+        "opt_state": opt.init(staged),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step = make_interleaved_pipeline_lm_train_step(mesh, cfg, opt, M, V)
+    state, l1 = step(state, tokens)
+    assert abs(float(l1) - ref_loss) < 1e-4, (float(l1), ref_loss)
+    losses = [float(l1)]
+    for _ in range(4):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert int(state["step"]) == 5
+
+
 def test_interleaved_1f1b_transformer_equivalence():
     """Interleaved (virtual-stage) 1F1B through the real transformer:
     same loss and grads as the non-pipelined reference, with a 2-chunk
