@@ -47,6 +47,7 @@ def cmd_init(args) -> int:
     language = args.language or detect_language(root)
     language = _ask("Project language (jax/python/node/go)", language)
     dockerfile = create_dockerfile(root, language, log)
+    chart_existed = os.path.isdir(os.path.join(root, "chart"))
     create_chart(root, language, log)
     image = _ask("Container image to build (e.g. gcr.io/proj/app)", f"registry.local/{name}")
 
@@ -56,8 +57,45 @@ def cmd_init(args) -> int:
             image=image, dockerfile="Dockerfile", context=".", create_pull_secret=True
         )
     }
+    chart_values = None
+    if args.volume:
+        # --volume NAME:SIZE[:MOUNTPATH] — persistence through the chart
+        # engine's persistence.* convention (Deployment: standalone PVC;
+        # TPU StatefulSet: per-worker volumeClaimTemplates)
+        vols, mounts = [], []
+        for spec in args.volume:
+            parts = spec.split(":")
+            if (
+                len(parts) not in (2, 3)
+                or not all(parts)  # every present field must be non-empty
+            ):
+                log.warn(
+                    "[init] bad --volume %r (want NAME:SIZE[:MOUNTPATH])",
+                    spec,
+                )
+                return 1
+            vols.append({"name": parts[0], "size": parts[1]})
+            if len(parts) == 3:
+                mounts.append({"name": parts[0], "mountPath": parts[2]})
+        chart_values = {"persistence": {"volumes": vols, "mounts": mounts}}
+        # a kept pre-existing chart may predate the persistence plumbing:
+        # values would then render nothing — data silently non-durable
+        kept_values = os.path.join(root, "chart", "values.yaml")
+        if chart_existed and (
+            not os.path.isfile(kept_values)
+            or "persistence" not in open(kept_values, encoding="utf-8").read()
+        ):
+            log.warn(
+                "[init] --volume set but the existing chart/ has no "
+                "persistence support — re-scaffold the chart (move it "
+                "aside and rerun init) or add persistence.* plumbing "
+                "to its templates, or no PVC will be created"
+            )
     cfg.deployments = [
-        latest.DeploymentConfig(name=name, chart=latest.ChartConfig(path="./chart"))
+        latest.DeploymentConfig(
+            name=name,
+            chart=latest.ChartConfig(path="./chart", values=chart_values),
+        )
     ]
     if language == "jax":
         accelerator = _ask("TPU accelerator type", "v5litepod-8")
@@ -1323,6 +1361,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("init", help="scaffold Dockerfile, chart and config")
     sp.add_argument("--language", choices=["jax", "python", "node", "go"])
     sp.add_argument("--reconfigure", action="store_true")
+    sp.add_argument(
+        "--volume",
+        action="append",
+        default=[],
+        metavar="NAME:SIZE[:MOUNTPATH]",
+        help="declare a persistent volume (repeatable); rendered as a "
+        "PVC (cpu chart) or per-worker volumeClaimTemplate (TPU chart)",
+    )
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("dev", help="build, deploy and start the live dev session")

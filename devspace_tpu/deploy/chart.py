@@ -128,6 +128,7 @@ def render_chart(
             merged_values = merge(merged_values, yaml.safe_load(fh) or {})
     if values:
         merged_values = merge(merged_values, values)
+    _derive_persistence(merged_values)
     context = {
         "values": merged_values,
         "release": {"name": release_name, "namespace": namespace},
@@ -169,6 +170,10 @@ def render_chart(
             sub_values = merge(pkg_values, overrides)
             if scope is None and "global" in merged_values:
                 sub_values = merge(sub_values, {"global": merged_values["global"]})
+            # dialect packages follow the same persistence convention as
+            # the parent (helm packages template their own PVCs and don't
+            # read the derived keys — harmless either way)
+            _derive_persistence(sub_values)
             pkg_context = {
                 **context,
                 "values": sub_values,
@@ -181,6 +186,78 @@ def render_chart(
     if not manifests:
         raise ChartError(f"chart {chart_path} rendered no manifests")
     return manifests
+
+
+def _derive_persistence(values: dict) -> None:
+    """Engine convention for stateful workloads: a single
+    ``persistence.volumes`` list — ``[{name, size, storageClass?,
+    accessModes?}]``, the reference's ``volumes:`` values shape
+    (/root/reference/examples/php-mysql-example/chart/values.yaml) — is
+    expanded IN PLACE into the three k8s-native derived lists templates
+    consume, so chart authors declare a volume once:
+
+    - ``persistence.claims``      [{name, spec}]         standalone PVCs
+      (Deployment + shared claim, via x-devspace-for-each)
+    - ``persistence.attach``      pod-spec ``volumes:`` claim references
+    - ``persistence.claimTemplates``  StatefulSet ``volumeClaimTemplates``
+      (per-replica claims — each TPU slice worker gets its own, the
+      durable-checkpoint-dir story)
+
+    ``persistence.mounts`` (k8s-native volumeMounts) stays user-written —
+    only the author knows the paths. Explicitly-set derived keys win
+    (they are only filled when absent)."""
+    pers = values.get("persistence")
+    if not isinstance(pers, dict):
+        return
+    vols = pers.get("volumes") or []
+    if not isinstance(vols, list):
+        raise ChartError("persistence.volumes must be a list")
+
+    def claim_spec(v: dict) -> dict:
+        if not isinstance(v, dict) or not v.get("name") or not v.get("size"):
+            raise ChartError(
+                f"persistence.volumes entries need name+size, got {v!r}"
+            )
+        spec = {
+            "accessModes": v.get("accessModes") or ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": str(v["size"])}},
+        }
+        if v.get("storageClass"):
+            spec["storageClassName"] = v["storageClass"]
+        return spec
+
+    pers.setdefault(
+        "claims", [{"name": v["name"], "spec": claim_spec(v)} for v in vols]
+    )
+    pers.setdefault(
+        "attach",
+        [
+            {
+                "name": v["name"],
+                "persistentVolumeClaim": {"claimName": v["name"]},
+            }
+            for v in vols
+        ],
+    )
+    pers.setdefault(
+        "claimTemplates",
+        [
+            {"metadata": {"name": v["name"]}, "spec": claim_spec(v)}
+            for v in vols
+        ],
+    )
+    pers.setdefault("mounts", [])
+
+
+# Doc-level expansion directive: a template document carrying this key is
+# rendered once per element of the referenced list (dotted context path),
+# with ``item`` / ``itemIndex`` added to the context — and dropped
+# entirely when the list is empty. The chart language stays pure
+# substitution otherwise; this is its one iteration construct (used by
+# the generator charts' volumes.yaml to emit one PVC per declared volume,
+# the reference's range loop at
+# examples/php-mysql-example/chart/templates/volumes.yaml).
+FOR_EACH_KEY = "x-devspace-for-each"
 
 
 def _render_templates(
@@ -202,13 +279,30 @@ def _render_templates(
         for doc in docs:
             if not doc:
                 continue
-            rendered = render_value(doc, context)
-            if not isinstance(rendered, dict) or "kind" not in rendered:
-                raise ChartError(f"{path}: rendered doc has no kind")
-            rendered.setdefault("metadata", {}).setdefault("namespace", namespace)
-            labels = rendered["metadata"].setdefault("labels", {})
-            labels.setdefault("devspace.tpu/release", release_name)
-            manifests.append(rendered)
+            contexts = [context]
+            if isinstance(doc, dict) and FOR_EACH_KEY in doc:
+                list_path = str(doc[FOR_EACH_KEY])
+                doc = {k: v for k, v in doc.items() if k != FOR_EACH_KEY}
+                items = _lookup(context, list_path)
+                if not isinstance(items, list):
+                    raise ChartError(
+                        f"{path}: {FOR_EACH_KEY} target {list_path!r} is "
+                        f"not a list"
+                    )
+                contexts = [
+                    {**context, "item": it, "itemIndex": i}
+                    for i, it in enumerate(items)
+                ]
+            for ctx in contexts:
+                rendered = render_value(doc, ctx)
+                if not isinstance(rendered, dict) or "kind" not in rendered:
+                    raise ChartError(f"{path}: rendered doc has no kind")
+                rendered.setdefault("metadata", {}).setdefault(
+                    "namespace", namespace
+                )
+                labels = rendered["metadata"].setdefault("labels", {})
+                labels.setdefault("devspace.tpu/release", release_name)
+                manifests.append(rendered)
     return manifests
 
 

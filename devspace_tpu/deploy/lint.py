@@ -40,6 +40,35 @@ _WORKLOAD_KINDS = {
     "Job",
     "ReplicaSet",
 }
+# k8s resource.Quantity for storage requests (decimal/binary SI suffixes)
+_QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(m|k|Ki|M|Mi|G|Gi|T|Ti|P|Pi|E|Ei)?$")
+_ACCESS_MODES = {
+    "ReadWriteOnce",
+    "ReadOnlyMany",
+    "ReadWriteMany",
+    "ReadWriteOncePod",
+}
+
+
+def _lint_claim_spec(label: str, spec: dict, issues: list) -> None:
+    """Shared PVC-spec checks for standalone claims and StatefulSet
+    volumeClaimTemplates."""
+    storage = (
+        ((spec.get("resources") or {}).get("requests") or {}).get("storage")
+    )
+    if not storage:
+        issues.append(f"{label}: no resources.requests.storage")
+    elif not _QUANTITY.match(str(storage)):
+        issues.append(
+            f"{label}: storage {storage!r} is not a k8s quantity "
+            f"(e.g. 5Gi, 500Mi)"
+        )
+    for mode in spec.get("accessModes") or []:
+        if mode not in _ACCESS_MODES:
+            issues.append(f"{label}: unknown accessMode {mode!r}")
+    sc = spec.get("storageClassName")
+    if sc is not None and (not isinstance(sc, str) or not sc):
+        issues.append(f"{label}: storageClassName must be a non-empty string")
 
 
 def _containers(doc: dict) -> list[dict]:
@@ -103,6 +132,41 @@ def validate_manifests(docs: list[dict]) -> list[str]:
                     f"{label}: selector.matchLabels not matched by "
                     f"template labels ({sel} vs {tmpl_labels})"
                 )
+        if kind == "PersistentVolumeClaim":
+            _lint_claim_spec(label, doc.get("spec") or {}, issues)
+        if kind in _WORKLOAD_KINDS or kind == "Pod":
+            pod = _pod_spec(doc)
+            declared = {
+                v.get("name")
+                for v in pod.get("volumes") or []
+                if isinstance(v, dict)
+            }
+            for tmpl in (doc.get("spec") or {}).get(
+                "volumeClaimTemplates"
+            ) or []:
+                tname = (tmpl.get("metadata") or {}).get("name")
+                tlabel = f"{label}: volumeClaimTemplates[{tname or '?'}]"
+                if not tname:
+                    issues.append(f"{tlabel}: missing metadata.name")
+                elif not _DNS1123.match(str(tname)):
+                    issues.append(f"{tlabel}: name not DNS-1123")
+                else:
+                    declared.add(tname)
+                _lint_claim_spec(tlabel, tmpl.get("spec") or {}, issues)
+            for c in _containers(doc):
+                for m in c.get("volumeMounts") or []:
+                    mname = m.get("name") if isinstance(m, dict) else None
+                    if not mname or not m.get("mountPath"):
+                        issues.append(
+                            f"{label}: container {c.get('name', '?')} has a "
+                            f"volumeMount without name+mountPath ({m!r})"
+                        )
+                    elif mname not in declared:
+                        issues.append(
+                            f"{label}: container {c.get('name', '?')} mounts "
+                            f"undeclared volume {mname!r} (pod volumes/"
+                            f"claimTemplates: {sorted(declared) or 'none'})"
+                        )
         if kind == "StatefulSet":
             svc = (doc.get("spec") or {}).get("serviceName")
             if not svc:

@@ -1,0 +1,69 @@
+"""Guestbook app for the stateful walkthrough (the reference's php-mysql
+example, /root/reference/examples/php-mysql-example, re-imagined as a
+stdlib Python app): entries are written to the database at DB_HOST and
+uploads land on the app's own persistent volume at /data — both survive
+pod restarts, which is the point of the example.
+"""
+
+import json
+import os
+import socket
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+DB_HOST = os.environ.get("DB_HOST", "localhost")
+DB_PORT = int(os.environ.get("DB_PORT", "3306"))
+DATA_DIR = os.environ.get("DATA_DIR", "/data")
+
+
+def db_reachable() -> bool:
+    try:
+        with socket.create_connection((DB_HOST, DB_PORT), timeout=2):
+            return True
+    except OSError:
+        return False
+
+
+class Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._json(200, {"ok": True, "db": db_reachable()})
+            return
+        entries_path = os.path.join(DATA_DIR, "entries.json")
+        entries = []
+        if os.path.exists(entries_path):
+            with open(entries_path, encoding="utf-8") as fh:
+                entries = json.load(fh)
+        self._json(200, {"entries": entries, "db_host": DB_HOST})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            entry = json.loads(self.rfile.read(length))["entry"]
+        except (json.JSONDecodeError, KeyError):
+            self._json(400, {"error": "body must be {\"entry\": ...}"})
+            return
+        os.makedirs(DATA_DIR, exist_ok=True)
+        entries_path = os.path.join(DATA_DIR, "entries.json")
+        entries = []
+        if os.path.exists(entries_path):
+            with open(entries_path, encoding="utf-8") as fh:
+                entries = json.load(fh)
+        entries.append(entry)
+        with open(entries_path, "w", encoding="utf-8") as fh:
+            json.dump(entries, fh)
+        self._json(200, {"stored": len(entries)})
+
+
+if __name__ == "__main__":
+    print(f"guestbook on :8080 (db {DB_HOST}:{DB_PORT}, data {DATA_DIR})")
+    ThreadingHTTPServer(("0.0.0.0", 8080), Handler).serve_forever()
