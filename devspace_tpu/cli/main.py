@@ -1589,10 +1589,94 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _maybe_warn_newer_version(command: str) -> None:
+    """Startup newer-version notice (reference: cmd/root.go:42 ->
+    upgrade.CheckForNewerVersion — every invocation warns when a newer
+    CLI exists; upstream asks the GitHub releases API and skips
+    alpha/beta builds). Zero-egress equivalent: scan the release-channel
+    directory (``DEVSPACE_RELEASE_DIR``, the same artifacts ``upgrade
+    --archive`` consumes) for a newer stable archive, at most once per
+    day (stamped in ``~/.devspace/version_check.json``), and print the
+    reference's hint. Never raises — a broken channel must not break
+    the command being run."""
+    import json as _json
+    import tarfile as _tarfile
+    import time as _time
+
+    from .. import __version__
+
+    if command in ("upgrade", "print"):
+        return  # upgrade IS the action; print output is parsed by tools
+    if os.environ.get("DEVSPACE_SKIP_VERSION_CHECK") == "1":
+        return
+    if "-" in __version__:
+        return  # pre-release builds don't nag (reference: root.go:38)
+    release_dir = os.environ.get("DEVSPACE_RELEASE_DIR")
+    if not release_dir or not os.path.isdir(release_dir):
+        return
+    stamp_path = os.path.join(
+        os.path.expanduser("~"), ".devspace", "version_check.json"
+    )
+    now = _time.time()
+    # the "never raises" guarantee is structural, not per-site: any
+    # surprise in the stamp file, a hostile tarball member, or the
+    # channel dir itself must degrade to "no notice", not a traceback
+    # before the user's actual command runs
+    try:
+        try:
+            with open(stamp_path, encoding="utf-8") as fh:
+                stamp = _json.load(fh)
+            if (
+                isinstance(stamp, dict)
+                and stamp.get("release_dir") == release_dir
+                and now - float(stamp.get("checked_at") or 0) < 86400
+            ):
+                return  # warned (or found nothing) within the last day
+        except (OSError, ValueError, TypeError):
+            pass
+        from ..deploy.packages import _version_key
+
+        newest: Optional[tuple] = None  # (key, version, path)
+        for name in sorted(os.listdir(release_dir)):
+            if not name.endswith((".tar.gz", ".tgz")):
+                continue
+            path = os.path.join(release_dir, name)
+            try:
+                with _tarfile.open(path, "r:gz") as tf:
+                    version, _ = _archive_version(tf)
+            except Exception:  # noqa: BLE001 — any malformed archive
+                continue
+            if not version or "-" in version:
+                continue  # pre-releases never count as upgrades
+            key = _version_key(version)
+            if key > _version_key(__version__) and (
+                newest is None or key > newest[0]
+            ):
+                newest = (key, version, path)
+        try:
+            os.makedirs(os.path.dirname(stamp_path), exist_ok=True)
+            with open(stamp_path, "w", encoding="utf-8") as fh:
+                _json.dump(
+                    {"checked_at": now, "release_dir": release_dir}, fh
+                )
+        except OSError:
+            pass  # stampless: worst case the scan repeats next run
+        if newest is not None:
+            logutil.get_logger().warn(
+                "There is a newer version of devspace-tpu v%s. Run "
+                "`devspace-tpu upgrade --archive %s` to update the cli.",
+                newest[1],
+                newest[2],
+            )
+    except Exception:  # noqa: BLE001
+        return
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.debug:
         logutil.get_logger().level = "debug"
+    _maybe_warn_newer_version(args.cmd)
     root = find_root(os.getcwd())
     if root is not None:
         # Mirror everything into .devspace/logs/default.log (reference:

@@ -171,9 +171,12 @@ def render_chart(
             if scope is None and "global" in merged_values:
                 sub_values = merge(sub_values, {"global": merged_values["global"]})
             # dialect packages follow the same persistence convention as
-            # the parent (helm packages template their own PVCs and don't
-            # read the derived keys — harmless either way)
-            _derive_persistence(sub_values)
+            # the parent; helm packages template their own PVCs with
+            # their own values schemas — deriving (and validating) there
+            # would break vendored upstream charts whose persistence:
+            # shape differs
+            if not is_helm_chart(pkg_dir):
+                _derive_persistence(sub_values)
             pkg_context = {
                 **context,
                 "values": sub_values,

@@ -52,6 +52,63 @@ def test_init_scaffolds_jax_project(project):
     assert main(["init"]) == 1
 
 
+def test_startup_newer_version_notice(project, tmp_path, monkeypatch):
+    """VERDICT r3 next #6 (reference cmd/root.go:42): with a newer
+    stable archive in DEVSPACE_RELEASE_DIR, any command prints the
+    upgrade hint — once per day (stamped under ~/.devspace), with
+    pre-release archives never counting."""
+    import io
+    import json
+    import tarfile
+
+    class RecordingLogger(logutil.Logger):
+        def __init__(self):
+            super().__init__()
+            self.lines = []
+
+        def _write(self, tag, msg):
+            self.lines.append(f"[{tag}] {msg}")
+
+    rec = RecordingLogger()
+    logutil.set_logger(rec)
+
+    releases = tmp_path / "releases"
+    releases.mkdir()
+
+    def make_archive(version, name):
+        init = f'__version__ = "{version}"\n'.encode()
+        with tarfile.open(str(releases / name), "w:gz") as tf:
+            info = tarfile.TarInfo("pkg/devspace_tpu/__init__.py")
+            info.size = len(init)
+            tf.addfile(info, io.BytesIO(init))
+
+    make_archive("9.9.9", "devspace-tpu-9.9.9.tar.gz")
+    make_archive("10.0.0-rc1", "devspace-tpu-10.0.0-rc1.tar.gz")
+    home = tmp_path / "home"
+    home.mkdir()
+    monkeypatch.setenv("HOME", str(home))
+    monkeypatch.setenv("DEVSPACE_RELEASE_DIR", str(releases))
+    monkeypatch.delenv("DEVSPACE_SKIP_VERSION_CHECK", raising=False)
+
+    assert main(["init"]) == 0
+    combined = "\n".join(rec.lines)
+    assert "newer version of devspace-tpu v9.9.9" in combined
+    assert "10.0.0" not in combined  # pre-release ignored
+    # stamped: the next run within a day stays silent
+    assert (home / ".devspace" / "version_check.json").exists()
+    rec.lines.clear()
+    assert main(["status", "deployments"]) == 0
+    assert "newer version" not in "\n".join(rec.lines)
+    # stale stamp: the notice fires again
+    stamp = home / ".devspace" / "version_check.json"
+    data = json.loads(stamp.read_text())
+    data["checked_at"] = 0
+    stamp.write_text(json.dumps(data))
+    rec.lines.clear()
+    assert main(["status", "deployments"]) == 0
+    assert "newer version of devspace-tpu v9.9.9" in "\n".join(rec.lines)
+
+
 def test_init_volume_flag_renders_claim_template(project):
     """`init --volume ckpt:20Gi:/ckpt` must wire persistence values into
     the config so the scaffolded TPU chart renders per-worker
