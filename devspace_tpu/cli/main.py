@@ -1636,11 +1636,26 @@ def _maybe_warn_newer_version(command: str) -> None:
             pass
         from ..deploy.packages import _version_key
 
+        import re as _re
+
         newest: Optional[tuple] = None  # (key, version, path)
+        cur_key = _version_key(__version__)
         for name in sorted(os.listdir(release_dir)):
             if not name.endswith((".tar.gz", ".tgz")):
                 continue
             path = os.path.join(release_dir, name)
+            # filename-first screening: decompressing every archive in
+            # the channel just to read __init__.py would stall the first
+            # command of the day on a channel of multi-hundred-MB
+            # tarballs; a version-looking filename that is not an
+            # upgrade skips the open. The archive's embedded version
+            # stays the truth for anything that passes (or has an
+            # unparseable name).
+            m = _re.search(r"(\d+\.\d+[^/]*?)\.(tar\.gz|tgz)$", name)
+            if m and (
+                "-" in m.group(1) or _version_key(m.group(1)) <= cur_key
+            ):
+                continue
             try:
                 with _tarfile.open(path, "r:gz") as tf:
                     version, _ = _archive_version(tf)
@@ -1649,9 +1664,7 @@ def _maybe_warn_newer_version(command: str) -> None:
             if not version or "-" in version:
                 continue  # pre-releases never count as upgrades
             key = _version_key(version)
-            if key > _version_key(__version__) and (
-                newest is None or key > newest[0]
-            ):
+            if key > cur_key and (newest is None or key > newest[0]):
                 newest = (key, version, path)
         try:
             os.makedirs(os.path.dirname(stamp_path), exist_ok=True)

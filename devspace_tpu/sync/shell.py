@@ -251,8 +251,39 @@ def build_tar(
 ) -> bytes:
     """Gzipped tar of local files, paths relative to the sync root,
     preserving mtimes (so remote stat equals the index) and re-applying
-    recorded remote mode/uid/gid (reference: tar.go:246-292)."""
+    recorded remote mode/uid/gid (reference: tar.go:246-292).
+
+    Large batches (the initial-sync snapshot of a many-small-files tree)
+    assemble the tar in native code when libdevsync is available —
+    CPython's per-member TarInfo bookkeeping costs ~10x the actual I/O
+    at 10k files (docs/PERF.md) — and gzip here either way."""
     import os
+
+    from ..utils import native
+
+    if len(entries) >= 64:  # small batches: ctypes round-trip isn't worth it
+        raw = native.pack_tar(
+            local_root,
+            [
+                native.PackEntry(
+                    name=info.name,
+                    is_dir=bool(info.is_directory),
+                    mode=(
+                        info.remote_mode
+                        if info.remote_mode is not None
+                        else (0o755 if info.is_directory else -1)
+                    ),
+                    uid=info.remote_uid if info.remote_uid is not None else -1,
+                    gid=info.remote_gid if info.remote_gid is not None else -1,
+                    mtime=int(info.mtime),
+                )
+                for info in entries
+            ],
+        )
+        if raw is not None:
+            import gzip
+
+            return gzip.compress(raw, compresslevel=4)
 
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w:gz", compresslevel=4) as tf:

@@ -17,7 +17,7 @@ import subprocess
 import threading
 from typing import Iterator, NamedTuple, Optional
 
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -94,6 +94,12 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(path)
             lib.ds_walk.restype = ctypes.c_void_p
             lib.ds_walk.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+            lib.ds_pack.restype = ctypes.c_void_p
+            lib.ds_pack.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
             lib.ds_free.argtypes = [ctypes.c_void_p]
             lib.ds_abi_version.restype = ctypes.c_uint64
             if lib.ds_abi_version() != _ABI_VERSION:
@@ -150,6 +156,50 @@ def _parse(raw: str) -> Iterator[WalkEntry]:
             )
         except ValueError:
             continue
+
+
+class PackEntry(NamedTuple):
+    name: str  # '/'-separated path relative to the pack root
+    is_dir: bool
+    mode: int  # -1 = derive (files: st_mode & 0o7777; dirs: 0755)
+    uid: int  # -1 = 0 (TarInfo default)
+    gid: int  # -1 = 0
+    mtime: int  # used for dirs; files stamp their stat mtime
+
+
+def pack_tar(root: str, entries: list[PackEntry]) -> Optional[bytes]:
+    """Native UNCOMPRESSED tar of ``entries`` under ``root`` (GNU format,
+    @LongLink for >=100-char names); None when the library is
+    unavailable or an entry name can't ride the line protocol (caller
+    falls back to the Python tarfile path). Entries whose stat/open
+    fails are skipped — the raced-delete semantics of the Python
+    builder. Compression stays in Python: zlib is already C, and the
+    per-member header bookkeeping is what the native path removes."""
+    lib = load()
+    if lib is None:
+        return None
+    lines = []
+    for e in entries:
+        if "\t" in e.name or "\n" in e.name:
+            return None  # pathological name: let tarfile handle it
+        lines.append(
+            f"{e.name}\t{1 if e.is_dir else 0}\t{e.mode}\t{e.uid}\t"
+            f"{e.gid}\t{e.mtime}\n"
+        )
+    n = ctypes.c_uint64()
+    # surrogateescape round-trips non-UTF-8 filenames (the walk decodes
+    # them the same way); the C side treats names as opaque bytes
+    ptr = lib.ds_pack(
+        root.encode("utf-8", "surrogateescape"),
+        "".join(lines).encode("utf-8", "surrogateescape"),
+        ctypes.byref(n),
+    )
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr, n.value)
+    finally:
+        lib.ds_free(ptr)
 
 
 def prune_names(excludes: Optional[list[str]]) -> list[str]:

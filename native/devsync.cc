@@ -73,12 +73,188 @@ bool pruned(const std::vector<std::string>& prune, const char* name) {
   return false;
 }
 
+// --- tar assembly (ds_pack) -------------------------------------------------
+// The initial-sync upstream batch packs thousands of small files; CPython's
+// tarfile spends ~70us per member on TarInfo/header bookkeeping, an order
+// of magnitude over the actual I/O (measured in docs/PERF.md). The packer
+// emits an UNCOMPRESSED GNU-format tar — gzip stays in Python (zlib is C
+// already), and the format matches what tarfile reads on the remote side.
+
+void raw_append(Output& out, const char* data, size_t n) {
+  out.ensure(n);
+  memcpy(out.buf + out.len, data, n);
+  out.len += n;
+}
+
+// Does ``value`` fit a ``len``-byte octal header field (len-1 digits)?
+// Overflow must abort the whole pack (caller falls back to Python's PAX
+// writer) — a truncated size field would silently misalign every
+// following member.
+bool fits_octal(unsigned long long value, size_t len) {
+  unsigned long long limit = 1;
+  for (size_t i = 0; i + 1 < len; i++) limit *= 8;
+  return value < limit;
+}
+
+void pack_octal(char* field, size_t len, unsigned long long value) {
+  // via scratch: silences -Wformat-truncation (callers pre-check with
+  // fits_octal; this is belt-and-suspenders)
+  char tmp[32];
+  int n = snprintf(tmp, sizeof tmp, "%0*llo", static_cast<int>(len - 1), value);
+  memcpy(field, tmp, static_cast<size_t>(n) < len ? n + 1 : len);
+}
+
+void tar_header(Output& out, const std::string& name, unsigned long long mode,
+                unsigned long long uid, unsigned long long gid,
+                unsigned long long size, unsigned long long mtime,
+                char typeflag) {
+  char hdr[512];
+  memset(hdr, 0, sizeof hdr);
+  size_t nlen = name.size();
+  memcpy(hdr, name.data(), nlen < 100 ? nlen : 100);
+  pack_octal(hdr + 100, 8, mode);
+  pack_octal(hdr + 108, 8, uid);
+  pack_octal(hdr + 116, 8, gid);
+  pack_octal(hdr + 124, 12, size);
+  pack_octal(hdr + 136, 12, mtime);
+  memset(hdr + 148, ' ', 8);  // checksum computed over spaces
+  hdr[156] = typeflag;
+  memcpy(hdr + 257, "ustar  ", 8);  // GNU magic+version ("ustar  \0")
+  unsigned sum = 0;
+  for (size_t i = 0; i < sizeof hdr; i++) sum += static_cast<unsigned char>(hdr[i]);
+  char chk[16];
+  snprintf(chk, sizeof chk, "%06o", sum);
+  memcpy(hdr + 148, chk, 7);  // "dddddd\0"
+  hdr[155] = ' ';  // canonical terminator: NUL then space
+  raw_append(out, hdr, sizeof hdr);
+}
+
+void tar_pad(Output& out, size_t written) {
+  static const char zeros[512] = {0};
+  size_t rem = written % 512;
+  if (rem) raw_append(out, zeros, 512 - rem);
+}
+
+// GNU @LongLink extension for member names that don't fit the 100-byte
+// header field (what tarfile's GNU writer emits; its reader consumes it).
+void tar_name(Output& out, const std::string& name, unsigned long long mtime) {
+  if (name.size() < 100) return;
+  tar_header(out, "././@LongLink", 0644, 0, 0, name.size() + 1, mtime, 'L');
+  raw_append(out, name.c_str(), name.size() + 1);
+  tar_pad(out, name.size() + 1);
+}
+
 }  // namespace
 
 extern "C" {
 
 // ABI version so the Python loader can refuse a stale build.
-uint64_t ds_abi_version() { return 1; }
+uint64_t ds_abi_version() { return 2; }
+
+// Pack local files into an uncompressed GNU tar. ``entries`` is
+// newline-separated records ``relpath\tis_dir\tmode\tuid\tgid\tmtime``
+// (mode/uid/gid decimal, -1 = "use/derive the local default": files take
+// st_mode&07777 and uid/gid 0 — exactly the Python builder's TarInfo
+// defaults in sync/shell.py build_tar; dirs take 0755). Entries whose
+// stat/open fails are skipped (raced concurrent delete, same as the
+// Python path). Returns a malloc'd buffer (*out_len bytes; free with
+// ds_free), or null on allocation/argument failure.
+char* ds_pack(const char* root, const char* entries, uint64_t* out_len) {
+  if (!root || !entries || !out_len) return nullptr;
+  Output out;
+  const char* p = entries;
+  std::string root_s(root);
+  if (!root_s.empty() && root_s.back() != '/') root_s += '/';
+  std::vector<char> iobuf(1 << 16);
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    size_t linelen = nl ? static_cast<size_t>(nl - p) : strlen(p);
+    std::string line(p, linelen);
+    p += linelen + (nl ? 1 : 0);
+    // split 6 tab fields
+    std::vector<std::string> f;
+    size_t start = 0;
+    for (size_t i = 0; i <= line.size(); i++) {
+      if (i == line.size() || line[i] == '\t') {
+        f.emplace_back(line, start, i - start);
+        start = i + 1;
+      }
+    }
+    if (f.size() != 6 || f[0].empty()) continue;
+    const std::string& name = f[0];
+    bool is_dir = f[1] == "1";
+    long long mode = atoll(f[2].c_str());
+    long long uid = atoll(f[3].c_str());
+    long long gid = atoll(f[4].c_str());
+    long long mtime = atoll(f[5].c_str());
+    // any value the fixed octal fields can't carry (>=8GiB files,
+    // uid/gid > 2097151, pre-1970 or far-future mtimes) aborts the
+    // native pack — Python's PAX writer handles those fine
+    if (mtime < 0 || !fits_octal(static_cast<unsigned long long>(mtime), 12) ||
+        (uid >= 0 && !fits_octal(static_cast<unsigned long long>(uid), 8)) ||
+        (gid >= 0 && !fits_octal(static_cast<unsigned long long>(gid), 8)) ||
+        (mode >= 0 && !fits_octal(static_cast<unsigned long long>(mode), 8))) {
+      free(out.buf);
+      return nullptr;
+    }
+    if (is_dir) {
+      std::string dname = name + "/";
+      tar_name(out, dname, static_cast<unsigned long long>(mtime));
+      tar_header(out, dname,
+                 static_cast<unsigned long long>(mode >= 0 ? mode : 0755),
+                 static_cast<unsigned long long>(uid >= 0 ? uid : 0),
+                 static_cast<unsigned long long>(gid >= 0 ? gid : 0), 0,
+                 static_cast<unsigned long long>(mtime), '5');
+      continue;
+    }
+    std::string abs = root_s + name;
+    struct stat st;
+    if (stat(abs.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    unsigned long long size = static_cast<unsigned long long>(st.st_size);
+    if (!fits_octal(size, 12) || st.st_mtim.tv_sec < 0 ||
+        !fits_octal(static_cast<unsigned long long>(st.st_mtim.tv_sec), 12)) {
+      free(out.buf);
+      return nullptr;
+    }
+    FILE* fh = fopen(abs.c_str(), "rb");
+    if (!fh) continue;
+    tar_name(out, name, static_cast<unsigned long long>(st.st_mtim.tv_sec));
+    tar_header(out, name,
+               static_cast<unsigned long long>(
+                   mode >= 0 ? mode : (st.st_mode & 07777)),
+               static_cast<unsigned long long>(uid >= 0 ? uid : 0),
+               static_cast<unsigned long long>(gid >= 0 ? gid : 0), size,
+               static_cast<unsigned long long>(st.st_mtim.tv_sec), '0');
+    unsigned long long copied = 0;
+    while (copied < size) {
+      size_t want = iobuf.size();
+      if (size - copied < want) want = static_cast<size_t>(size - copied);
+      size_t got = fread(iobuf.data(), 1, want, fh);
+      if (got == 0) break;  // shrank underneath us: zero-fill the promise
+      raw_append(out, iobuf.data(), got);
+      copied += got;
+    }
+    fclose(fh);
+    if (copied < size) {
+      // header promised `size` bytes — keep the stream well-formed
+      static const char zeros[512] = {0};
+      while (copied < size) {
+        unsigned long long want = size - copied;
+        if (want > sizeof zeros) want = sizeof zeros;
+        raw_append(out, zeros, static_cast<size_t>(want));
+        copied += want;
+      }
+    }
+    tar_pad(out, static_cast<size_t>(size));
+  }
+  // end-of-archive: two zero blocks
+  static const char zeros[1024] = {0};
+  raw_append(out, zeros, sizeof zeros);
+  out.ensure(0);
+  out.buf[out.len] = 0;
+  *out_len = out.len;
+  return out.buf;
+}
 
 char* ds_walk(const char* root, const char* prune_csv, int follow_symlinks) {
   std::vector<std::string> prune;

@@ -97,6 +97,85 @@ def test_symlink_cycle_terminates(lib, tmp_path):
     assert "a/b" in result  # finished without spinning
 
 
+def test_native_pack_tar_matches_python_tarfile(lib, tmp_path, monkeypatch):
+    """VERDICT r3 next #8: the native tar packer must be member-for-member
+    identical to the Python tarfile builder — names (incl. GNU longname
+    >= 100 chars), dir entries, remote mode/uid/gid overrides, mtimes,
+    sizes and content — across the build_tar entry point."""
+    import io
+    import random
+    import tarfile
+
+    from devspace_tpu.sync.index import FileInformation
+    from devspace_tpu.sync.shell import build_tar
+
+    root = tmp_path / "tree"
+    rng = random.Random(0)
+    entries = []
+    for d in range(8):
+        dd = root / f"pkg{d}"
+        os.makedirs(dd)
+        entries.append(
+            FileInformation(
+                name=f"pkg{d}", size=0, mtime=1700000000 + d,
+                is_directory=True,
+            )
+        )
+        for f in range(12):
+            p = dd / f"m{f}.py"
+            p.write_bytes(bytes(rng.getrandbits(8) for _ in range(200)))
+            st = os.stat(p)
+            entries.append(
+                FileInformation(
+                    name=f"pkg{d}/m{f}.py", size=st.st_size,
+                    mtime=int(st.st_mtime), is_directory=False,
+                )
+            )
+    long_dir = "d" * 60 + "/" + "e" * 60
+    os.makedirs(root / long_dir)
+    lp = long_dir + "/" + "f" * 40 + ".txt"
+    (root / lp).write_bytes(b"longname content")
+    entries.append(
+        FileInformation(
+            name=lp, size=16, mtime=int(os.stat(root / lp).st_mtime),
+            is_directory=False,
+        )
+    )
+    # remote metadata overrides ride through
+    e = entries[1]
+    entries[1] = FileInformation(
+        name=e.name, size=e.size, mtime=e.mtime, is_directory=False,
+        remote_mode=0o600, remote_uid=1234, remote_gid=99,
+    )
+    assert len(entries) >= 64  # the native routing threshold
+
+    def members(gz):
+        out = {}
+        with tarfile.open(fileobj=io.BytesIO(gz), mode="r:gz") as tf:
+            for m in tf.getmembers():
+                data = tf.extractfile(m).read() if m.isfile() else b""
+                out[m.name.rstrip("/")] = (
+                    m.isdir(), m.mode, m.uid, m.gid, m.mtime, m.size, data
+                )
+        return out
+
+    monkeypatch.setenv("DEVSPACE_NATIVE", "0")
+    native._lib = None
+    native._load_failed = False
+    py = members(build_tar(str(root), entries))
+    monkeypatch.delenv("DEVSPACE_NATIVE")
+    native._lib = None
+    native._load_failed = False
+    nat = members(build_tar(str(root), entries))
+    assert set(py) == set(nat)
+    for k in py:
+        assert py[k] == nat[k], k
+    # deleted-underneath files are skipped, not fatal
+    (root / "pkg0" / "m0.py").unlink()
+    nat2 = members(build_tar(str(root), entries))
+    assert "pkg0/m0.py" not in nat2 and "pkg0/m1.py" in nat2
+
+
 def test_prune_names():
     assert native.prune_names([".git/", "node_modules", "*.pyc", "a/b", "/top"]) == [
         ".git",
