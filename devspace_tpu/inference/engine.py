@@ -11,7 +11,7 @@ Design, TPU-first:
   cost is already paid; admission fills them). No recompilation ever
   happens during serving.
 - **Paged KV cache** (vLLM-style): K/V lives in a block pool
-  ``[layers, n_blocks, block_size, kv_heads, head_dim]`` with per-slot
+  ``[layers, n_blocks, kv_heads, block_size, head_dim]`` with per-slot
   block tables, so HBM is bounded by the POOL size — not
   ``max_slots x max_len`` preallocation. Blocks are allocated as
   sequences grow; when the pool runs dry the youngest request is
@@ -247,7 +247,7 @@ class InferenceEngine:
                     f"'{model_axis}' ({mesh.shape[model_axis]})"
                 )
             pool_sharding = NamedSharding(
-                mesh, P(None, None, None, model_axis, None)
+                mesh, P(None, None, model_axis, None, None)
             )
             self.params = jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),

@@ -364,24 +364,27 @@ def decode_tokens(
 def init_paged_pool(
     cfg: TransformerConfig, n_blocks: int, block_size: int
 ) -> dict:
-    """Block pool: {"k","v"} of [L, n_blocks, block_size, Hkv, D].
-    Block 0 is reserved as a scratch/garbage block by the engine (parked
-    writes land there; unallocated table entries point at it)."""
-    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    """Block pool: {"k","v"} of [L, n_blocks, Hkv, block_size, D] —
+    head-major so each (block, head) is a contiguous [bs, D] tile, the
+    layout the Pallas paged-attention kernel's block specs require on
+    real TPU lowering (ops/paged_attention.py). Block 0 is reserved as a
+    scratch/garbage block by the engine (parked writes land there;
+    unallocated table entries point at it)."""
+    shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
 def _gather_pages(pool_layer, table):
-    """[n_blocks, bs, H, D] gathered by table [B, max_blocks] ->
+    """[n_blocks, H, bs, D] gathered by table [B, max_blocks] ->
     [B, max_blocks*bs, H, D] (a slot's logical cache view)."""
     b, mb = table.shape
-    _, bs, h, d = pool_layer.shape
-    return pool_layer[table].reshape(b, mb * bs, h, d)
+    _, h, bs, d = pool_layer.shape
+    return jnp.swapaxes(pool_layer[table], 2, 3).reshape(b, mb * bs, h, d)
 
 
 def decode_tokens_paged(
     params: dict,
-    pool: dict,  # {"k","v"} [L, n_blocks, bs, Hkv, D]
+    pool: dict,  # {"k","v"} [L, n_blocks, Hkv, bs, D]
     tables: jax.Array,  # [B, max_blocks] int32 block ids
     tokens: jax.Array,  # [B] int32
     positions: jax.Array,  # [B] int32 logical write position per sequence
@@ -399,7 +402,7 @@ def decode_tokens_paged(
 
     b = tokens.shape[0]
     hd = cfg.head_dim
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     cos, sin = rope_frequencies(cfg, positions)
 
     def rope1(x):
@@ -418,8 +421,8 @@ def decode_tokens_paged(
         v = (x @ layer["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
         q = rope1(q)
         k = rope1(k)
-        k_pool = pool["k"][li].at[blk, off].set(k[:, 0])
-        v_pool = pool["v"][li].at[blk, off].set(v[:, 0])
+        k_pool = pool["k"][li].at[blk, :, off].set(k[:, 0])
+        v_pool = pool["v"][li].at[blk, :, off].set(v[:, 0])
         new_k.append(k_pool)
         new_v.append(v_pool)
         ctx = paged_decode_attention(
@@ -457,7 +460,7 @@ def prefill_chunk_paged(
     c = tokens.shape[0]
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     t_alloc = table.shape[0] * bs
     positions = offset + jnp.arange(c, dtype=jnp.int32)  # [C]
     cos, sin = rope_frequencies(cfg, positions)
@@ -472,8 +475,8 @@ def prefill_chunk_paged(
         v = (x @ layer["wv"]).reshape(1, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        cur_k = cur_k.at[li, blk, off].set(k[0])
-        cur_v = cur_v.at[li, blk, off].set(v[0])
+        cur_k = cur_k.at[li, blk, :, off].set(k[0])
+        cur_v = cur_v.at[li, blk, :, off].set(v[0])
         keys = repeat_kv(_gather_pages(cur_k[li], table[None]), n_rep)
         vals = repeat_kv(_gather_pages(cur_v[li], table[None]), n_rep)
         scores = jnp.einsum(
@@ -568,7 +571,7 @@ def decode_block(
 
 def decode_block_paged(
     params: dict,
-    pool: dict,  # {"k","v"} [L, n_blocks, bs, Hkv, D]
+    pool: dict,  # {"k","v"} [L, n_blocks, Hkv, bs, D]
     tables: jax.Array,  # [B, max_blocks] int32 block ids
     tokens: jax.Array,  # [B, K] int32 token block per sequence
     positions: jax.Array,  # [B, K] int32 write positions (consecutive)
@@ -595,7 +598,7 @@ def decode_block_paged(
 
     b, kk = tokens.shape
     hd = cfg.head_dim
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     pos_flat = positions.reshape(-1)  # [B*K]
     cos, sin = rope_frequencies(cfg, pos_flat)
 
@@ -618,10 +621,10 @@ def decode_block_paged(
         v = (x @ layer["wv"]).reshape(b, kk, cfg.n_kv_heads, hd)
         q = rope_bk(q)
         k = rope_bk(k)
-        k_pool = pool["k"][li].at[blk, off].set(
+        k_pool = pool["k"][li].at[blk, :, off].set(
             k.reshape(b * kk, cfg.n_kv_heads, hd)
         )
-        v_pool = pool["v"][li].at[blk, off].set(
+        v_pool = pool["v"][li].at[blk, :, off].set(
             v.reshape(b * kk, cfg.n_kv_heads, hd)
         )
         new_k.append(k_pool)

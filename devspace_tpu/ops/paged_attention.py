@@ -35,16 +35,18 @@ NEG_INF = -1e30
 
 
 def paged_decode_reference(q, pool_k, pool_v, tables, lengths):
-    """Gather-based reference. q [B, H, D]; pool_k/v [N, bs, Hkv, D];
+    """Gather-based reference. q [B, H, D]; pool_k/v [N, Hkv, bs, D]
+    (head-major: each (block, head) is a contiguous [bs, D] tile — the
+    layout the TPU kernel's block specs require, see _paged_decode_pallas);
     tables [B, MB] int32; lengths [B] int32 (valid cache entries per
     slot, INCLUDING the current token) -> ctx [B, H, D] (q dtype)."""
     b, h, d = q.shape
-    n, bs, hkv, _ = pool_k.shape
+    n, hkv, bs, _ = pool_k.shape
     mb = tables.shape[1]
     n_rep = h // hkv
     t_alloc = mb * bs
-    keys = pool_k[tables].reshape(b, t_alloc, hkv, d)
-    vals = pool_v[tables].reshape(b, t_alloc, hkv, d)
+    keys = jnp.swapaxes(pool_k[tables], 2, 3).reshape(b, t_alloc, hkv, d)
+    vals = jnp.swapaxes(pool_v[tables], 2, 3).reshape(b, t_alloc, hkv, d)
     if n_rep > 1:
         keys = jnp.repeat(keys, n_rep, axis=2)
         vals = jnp.repeat(vals, n_rep, axis=2)
@@ -80,8 +82,8 @@ def _kernel(
     @pl.when(j * block_size < length)
     def _step():
         q = q_ref[0, 0]  # [n_rep, D]
-        k = k_ref[0, :, 0, :]  # [bs, D]
-        v = v_ref[0, :, 0, :]
+        k = k_ref[0, 0]  # [bs, D]
+        v = v_ref[0, 0]
         scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
         s = (
             jax.lax.dot_general(
@@ -115,18 +117,25 @@ def _paged_decode_pallas(q, pool_k, pool_v, tables, lengths):
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, d = q.shape
-    n, bs, hkv, _ = pool_k.shape
+    n, hkv, bs, _ = pool_k.shape
     mb = tables.shape[1]
     n_rep = h // hkv
     q4 = q.reshape(b, hkv, n_rep, d)
 
+    # Block shapes must keep the pools' LAST TWO dims whole: real TPU
+    # lowering requires the trailing block dims be (multiples of) the
+    # (8, 128) tile — a 1-sized head block in [..., Hkv, D] position is
+    # rejected on hardware (interpret mode never checks this). The
+    # head-major pool layout [N, Hkv, bs, D] makes each (block, head) a
+    # contiguous [bs, D] tile so one grid step DMAs exactly one head's
+    # block with a legal spec.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, lengths
         grid=(b, hkv, mb),
         in_specs=[
             pl.BlockSpec((1, 1, n_rep, d), lambda bi, hi, ji, t, L: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, bs, 1, d), lambda bi, hi, ji, t, L: (t[bi, ji], 0, hi, 0)),
-            pl.BlockSpec((1, bs, 1, d), lambda bi, hi, ji, t, L: (t[bi, ji], 0, hi, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, ji, t, L: (t[bi, ji], hi, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), lambda bi, hi, ji, t, L: (t[bi, ji], hi, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, n_rep, d), lambda bi, hi, ji, t, L: (bi, hi, 0, 0)
@@ -179,7 +188,7 @@ def paged_decode_attention(q, pool_k, pool_v, tables, lengths, tp=None):
     mesh, axis = tp
     from jax.sharding import PartitionSpec as P
 
-    head_sharded = P(None, None, axis, None)  # pools [N, bs, Hkv, D]
+    head_sharded = P(None, axis, None, None)  # pools [N, Hkv, bs, D]
     return jax.shard_map(
         impl,
         mesh=mesh,

@@ -290,10 +290,17 @@ def main():
 
     import json
 
+    from devspace_tpu.ops.dispatch import use_pallas
+
     result = {
         "metric": "serving_continuous_batching_tok_per_sec",
         "value": round(total_new / engine_s, 1) if engine_s else None,
         "unit": "tok/s",
+        # the r2 artifact was platform-ambiguous; make every capture
+        # self-describing so a CPU fallback can never pose as TPU
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "attention_impl": "pallas" if use_pallas() else "gather-reference",
         "vs_serial_generate": round(serial_s / engine_s, 2)
         if serial_s and engine_s
         else None,

@@ -493,10 +493,10 @@ def test_paged_decode_attention_kernel_matches_reference(pallas_interpret):
     n_blocks, bs, MB = 9, 8, 3
     q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
     pool_k = jnp.asarray(
-        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+        rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32)
     )
     pool_v = jnp.asarray(
-        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+        rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32)
     )
     tables = jnp.asarray(
         rng.integers(0, n_blocks, size=(B, MB)), dtype=jnp.int32
@@ -527,10 +527,10 @@ def test_paged_decode_attention_under_tp_mesh(pallas_interpret, monkeypatch):
     n_blocks, bs, MB = 9, 8, 3
     q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
     pool_k = jnp.asarray(
-        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+        rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32)
     )
     pool_v = jnp.asarray(
-        rng.normal(size=(n_blocks, bs, Hkv, D)).astype(np.float32)
+        rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32)
     )
     tables = jnp.asarray(
         rng.integers(0, n_blocks, size=(B, MB)), dtype=jnp.int32
