@@ -1648,13 +1648,13 @@ def _maybe_warn_newer_version(command: str) -> None:
             # the channel just to read __init__.py would stall the first
             # command of the day on a channel of multi-hundred-MB
             # tarballs; a version-looking filename that is not an
-            # upgrade skips the open. The archive's embedded version
-            # stays the truth for anything that passes (or has an
-            # unparseable name).
-            m = _re.search(r"(\d+\.\d+[^/]*?)\.(tar\.gz|tgz)$", name)
-            if m and (
-                "-" in m.group(1) or _version_key(m.group(1)) <= cur_key
-            ):
+            # upgrade skips the open. Only the LEADING numeric version
+            # is compared — a dash suffix may be a platform/build tag
+            # (2.0.0-linux-x86_64), not a pre-release, so anything
+            # numerically newer is opened and the archive's embedded
+            # version stays the truth (it rejects pre-releases below).
+            m = _re.search(r"(\d+(?:\.\d+)+)[^/]*\.(tar\.gz|tgz)$", name)
+            if m and _version_key(m.group(1)) <= cur_key:
                 continue
             try:
                 with _tarfile.open(path, "r:gz") as tf:

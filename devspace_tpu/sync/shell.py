@@ -293,7 +293,11 @@ def build_tar(
                 if info.is_directory:
                     ti = tarfile.TarInfo(info.name)
                     ti.type = tarfile.DIRTYPE
-                    ti.mode = info.remote_mode or 0o755
+                    ti.mode = (
+                        info.remote_mode
+                        if info.remote_mode is not None
+                        else 0o755  # same default as the native PackEntry path
+                    )
                     ti.mtime = info.mtime
                     tf.addfile(ti)
                 else:
@@ -307,10 +311,41 @@ def build_tar(
                     if info.remote_gid is not None:
                         ti.gid = info.remote_gid
                     with open(full, "rb") as fh:
-                        tf.addfile(ti, fh)
+                        # exactly ti.size bytes must follow the header: a
+                        # file truncated after the stat (concurrent
+                        # writer) would otherwise abort addfile mid-copy
+                        # and misalign every later member. Zero-fill the
+                        # shortfall like the native packer; the next
+                        # change event re-syncs the real content.
+                        tf.addfile(ti, _ExactSizeReader(fh, st.st_size))
             except OSError:
                 continue  # raced with a concurrent delete; skip
     return buf.getvalue()
+
+
+class _ExactSizeReader:
+    """Wraps a file object to deliver EXACTLY ``size`` bytes: truncates
+    a file that grew, zero-pads one that shrank (never raises on EOF) —
+    keeps the surrounding tar stream well-formed under concurrent
+    writes, matching the native packer's behavior."""
+
+    def __init__(self, fh, size: int):
+        self._fh = fh
+        self._left = size
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0 or n > self._left:
+            n = self._left
+        if n == 0:
+            return b""
+        try:
+            data = self._fh.read(n)
+        except OSError:
+            data = b""
+        if len(data) < n:
+            data += b"\0" * (n - len(data))
+        self._left -= n
+        return data
 
 
 def extract_tar(

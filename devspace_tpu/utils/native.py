@@ -105,7 +105,11 @@ def load() -> Optional[ctypes.CDLL]:
             if lib.ds_abi_version() != _ABI_VERSION:
                 _load_failed = True
                 return None
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a prebuilt library from an older ABI may
+            # lack newer symbols (e.g. ds_pack) — ctypes raises at the
+            # attribute bind, BEFORE ds_abi_version() gets a chance to
+            # reject it. Degrade to the Python path either way.
             _load_failed = True
             return None
         _lib = lib

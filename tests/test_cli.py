@@ -107,6 +107,15 @@ def test_startup_newer_version_notice(project, tmp_path, monkeypatch):
     rec.lines.clear()
     assert main(["status", "deployments"]) == 0
     assert "newer version of devspace-tpu v9.9.9" in "\n".join(rec.lines)
+    # a stable release with a platform/build suffix in the FILENAME is
+    # still an upgrade: the dash must not be misread as a pre-release
+    # (only the embedded version decides that)
+    make_archive("9.9.10", "devspace-tpu-9.9.10-linux-x86_64.tar.gz")
+    data["checked_at"] = 0
+    stamp.write_text(json.dumps(data))
+    rec.lines.clear()
+    assert main(["status", "deployments"]) == 0
+    assert "newer version of devspace-tpu v9.9.10" in "\n".join(rec.lines)
 
 
 def test_init_volume_flag_renders_claim_template(project):

@@ -147,6 +147,14 @@ def test_native_pack_tar_matches_python_tarfile(lib, tmp_path, monkeypatch):
         name=e.name, size=e.size, mtime=e.mtime, is_directory=False,
         remote_mode=0o600, remote_uid=1234, remote_gid=99,
     )
+    # mode 0 is a real value, not "unset": both paths must emit 000 for
+    # a dir whose recorded remote mode is 0 (not coerce it to 0o755)
+    d0 = entries[0]
+    assert d0.is_directory
+    entries[0] = FileInformation(
+        name=d0.name, size=0, mtime=d0.mtime, is_directory=True,
+        remote_mode=0,
+    )
     assert len(entries) >= 64  # the native routing threshold
 
     def members(gz):
@@ -190,3 +198,88 @@ def test_disable_via_env(lib, monkeypatch):
     monkeypatch.setenv("DEVSPACE_NATIVE", "0")
     assert native.load() is None
     assert native.walk("/tmp") is None
+
+
+def test_load_degrades_when_library_lacks_symbols(monkeypatch):
+    """A prebuilt libdevsync from an older ABI may lack newer symbols
+    (ds_pack): ctypes raises AttributeError at the attribute bind,
+    before ds_abi_version() can reject it — load() must degrade to None
+    (Python fallback), not crash every walk()/build_tar() caller."""
+    import ctypes
+
+    class OldLib:
+        class _Sym:  # ds_walk exists on any ABI
+            restype = None
+            argtypes = None
+
+        ds_walk = _Sym()
+
+        def __getattr__(self, name):  # ds_pack & co: not exported
+            raise AttributeError(name)
+
+    monkeypatch.setattr(ctypes, "CDLL", lambda path: OldLib())
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", False)
+    assert native.load() is None
+    assert native._load_failed  # sticky: no rebind attempt per call
+    # module state is monkeypatch-restored; the real lib reloads after
+
+
+def test_build_tar_zero_fills_file_truncated_mid_copy(tmp_path):
+    """A file that shrinks between build_tar's stat and the content copy
+    must yield a well-formed archive (shortfall zero-filled, later
+    members intact) — not abort mid-member and misalign the stream."""
+    import io
+    import tarfile
+
+    from devspace_tpu.sync.index import FileInformation
+    from devspace_tpu.sync import shell as shellmod
+
+    root = tmp_path / "tree"
+    os.makedirs(root)
+    (root / "a.txt").write_bytes(b"A" * 100)
+    (root / "b.txt").write_bytes(b"B" * 50)
+    entries = [
+        FileInformation(name="a.txt", size=100, mtime=1700000000,
+                        is_directory=False),
+        FileInformation(name="b.txt", size=50, mtime=1700000000,
+                        is_directory=False),
+    ]
+
+    real_open = open
+
+    def racing_open(path, *a, **kw):
+        fh = real_open(path, *a, **kw)
+        if str(path).endswith("a.txt"):
+            # simulate a concurrent truncation AFTER the stat: the
+            # reader sees EOF at 30 of the 100 stat'd bytes
+            data = fh.read(30)
+            fh.close()
+            return io.BytesIO(data)
+        return fh
+
+    import builtins
+
+    orig = builtins.open
+    builtins.open = racing_open
+    try:
+        gz = shellmod.build_tar(str(root), entries)
+    finally:
+        builtins.open = orig
+
+    with tarfile.open(fileobj=io.BytesIO(gz), mode="r:gz") as tf:
+        a = tf.extractfile("a.txt").read()
+        b = tf.extractfile("b.txt").read()
+    assert a == b"A" * 30 + b"\0" * 70  # header size honored, padded
+    assert b == b"B" * 50  # the NEXT member is untouched
+
+
+def test_exact_size_reader_truncates_grown_file():
+    import io
+
+    from devspace_tpu.sync.shell import _ExactSizeReader
+
+    r = _ExactSizeReader(io.BytesIO(b"x" * 99), 10)
+    assert r.read(7) == b"x" * 7
+    assert r.read() == b"x" * 3  # stops at the stat'd size
+    assert r.read() == b""
