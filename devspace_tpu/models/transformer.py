@@ -362,16 +362,60 @@ def decode_tokens(
 
 
 def init_paged_pool(
-    cfg: TransformerConfig, n_blocks: int, block_size: int
+    cfg: TransformerConfig,
+    n_blocks: int,
+    block_size: int,
+    kv_dtype=None,
 ) -> dict:
     """Block pool: {"k","v"} of [L, n_blocks, Hkv, block_size, D] —
     head-major so each (block, head) is a contiguous [bs, D] tile, the
     layout the Pallas paged-attention kernel's block specs require on
     real TPU lowering (ops/paged_attention.py). Block 0 is reserved as a
     scratch/garbage block by the engine (parked writes land there;
-    unallocated table entries point at it)."""
+    unallocated table entries point at it).
+
+    ``kv_dtype=jnp.int8`` stores K/V quantized (per-token-per-head
+    amax/127 scales in "k_scale"/"v_scale" [L, n_blocks, Hkv, bs] f32)
+    — the pool's HBM halves, so the same budget holds ~2x the blocks."""
     shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim)
+    if kv_dtype == jnp.int8 or kv_dtype == "int8":
+        sshape = shape[:-1]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
+    if kv_dtype is not None and kv_dtype != cfg.dtype:
+        raise ValueError(
+            f"unsupported kv_dtype {kv_dtype!r} (use jnp.int8/'int8', "
+            f"None, or the model dtype)"
+        )
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _quantize_kv_values(k, v) -> dict:
+    """Quantize a K/V pair for an int8 pool — the ONE place the scale
+    granularity/dtype convention lives; every pool write path (decode,
+    block-verify, prefill) scatters exactly these values."""
+    from ..ops.paged_attention import quantize_kv
+
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    return {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+
+
+def _paged_pool_write(pool: dict, li: int, blk, off, k, v) -> dict:
+    """Scatter per-token K/V ([M, Hkv, D] each, at (blk[m], :, off[m]))
+    into layer ``li`` of the pool, quantizing when the pool is int8.
+    Returns the updated per-layer arrays keyed like the pool."""
+    vals = (
+        _quantize_kv_values(k, v) if "k_scale" in pool else {"k": k, "v": v}
+    )
+    return {
+        key: pool[key][li].at[blk, :, off].set(val)
+        for key, val in vals.items()
+    }
 
 
 def _gather_pages(pool_layer, table):
@@ -380,6 +424,14 @@ def _gather_pages(pool_layer, table):
     b, mb = table.shape
     _, h, bs, d = pool_layer.shape
     return jnp.swapaxes(pool_layer[table], 2, 3).reshape(b, mb * bs, h, d)
+
+
+def _gather_scales(scale_layer, table):
+    """[n_blocks, H, bs] quant scales gathered by table [B, max_blocks]
+    -> [B, max_blocks*bs, H] (aligned with _gather_pages)."""
+    b, mb = table.shape
+    _, h, bs = scale_layer.shape
+    return jnp.swapaxes(scale_layer[table], 2, 3).reshape(b, mb * bs, h)
 
 
 def decode_tokens_paged(
@@ -413,7 +465,7 @@ def decode_tokens_paged(
     off = positions % bs
     lengths = positions + 1  # valid cache entries incl. the new token
     h = params["embed"][tokens][:, None, :]
-    new_k, new_v = [], []
+    new_pool: dict = {key: [] for key in pool}
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(b, 1, cfg.n_heads, hd)
@@ -421,12 +473,12 @@ def decode_tokens_paged(
         v = (x @ layer["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
         q = rope1(q)
         k = rope1(k)
-        k_pool = pool["k"][li].at[blk, :, off].set(k[:, 0])
-        v_pool = pool["v"][li].at[blk, :, off].set(v[:, 0])
-        new_k.append(k_pool)
-        new_v.append(v_pool)
+        upd = _paged_pool_write(pool, li, blk, off, k[:, 0], v[:, 0])
+        for key, arr in upd.items():
+            new_pool[key].append(arr)
         ctx = paged_decode_attention(
-            q[:, 0], k_pool, v_pool, tables, lengths, tp=tp
+            q[:, 0], upd["k"], upd["v"], tables, lengths, tp=tp,
+            k_scale=upd.get("k_scale"), v_scale=upd.get("v_scale"),
         )  # [B, H, D]
         h = h + (ctx.reshape(b, 1, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
@@ -434,7 +486,7 @@ def decode_tokens_paged(
         h = h + (gated @ layer["w_down"]).astype(h.dtype)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return logits, {key: jnp.stack(arrs) for key, arrs in new_pool.items()}
 
 
 def prefill_chunk_paged(
@@ -467,7 +519,8 @@ def prefill_chunk_paged(
     blk = table[positions // bs]  # [C]
     off = positions % bs
     h = params["embed"][tokens][None]  # [1, C, D]
-    cur_k, cur_v = pool["k"], pool["v"]
+    quantized = "k_scale" in pool
+    cur = dict(pool)
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(1, c, cfg.n_heads, hd)
@@ -475,10 +528,26 @@ def prefill_chunk_paged(
         v = (x @ layer["wv"]).reshape(1, c, cfg.n_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        cur_k = cur_k.at[li, blk, :, off].set(k[0])
-        cur_v = cur_v.at[li, blk, :, off].set(v[0])
-        keys = repeat_kv(_gather_pages(cur_k[li], table[None]), n_rep)
-        vals = repeat_kv(_gather_pages(cur_v[li], table[None]), n_rep)
+        wvals = (
+            _quantize_kv_values(k[0], v[0])
+            if quantized
+            else {"k": k[0], "v": v[0]}
+        )
+        for key, val in wvals.items():
+            cur[key] = cur[key].at[li, blk, :, off].set(val)
+        keys = _gather_pages(cur["k"][li], table[None])
+        vals = _gather_pages(cur["v"][li], table[None])
+        if quantized:
+            from ..ops.paged_attention import dequantize_kv
+
+            keys = dequantize_kv(
+                keys, _gather_scales(cur["k_scale"][li], table[None]), h.dtype
+            )
+            vals = dequantize_kv(
+                vals, _gather_scales(cur["v_scale"][li], table[None]), h.dtype
+            )
+        keys = repeat_kv(keys, n_rep)
+        vals = repeat_kv(vals, n_rep)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
         ) / jnp.sqrt(hd).astype(jnp.float32)
@@ -494,7 +563,7 @@ def prefill_chunk_paged(
         h = h + (gated @ layer["w_down"]).astype(h.dtype)
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     logits = (h[0] @ params["lm_head"]).astype(jnp.float32)  # [C, vocab]
-    return logits, {"k": cur_k, "v": cur_v}
+    return logits, cur
 
 
 def decode_block(
@@ -613,7 +682,7 @@ def decode_block_paged(
     tables_flat = jnp.repeat(tables, kk, axis=0)  # [B*K, MB]
     lengths = pos_flat + 1  # each token attends <= its own position
     h = params["embed"][tokens]  # [B, K, D]
-    new_k, new_v = [], []
+    new_pool: dict = {key: [] for key in pool}
     for li, layer in enumerate(params["layers"]):
         x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
         q = (x @ layer["wq"]).reshape(b, kk, cfg.n_heads, hd)
@@ -621,21 +690,22 @@ def decode_block_paged(
         v = (x @ layer["wv"]).reshape(b, kk, cfg.n_kv_heads, hd)
         q = rope_bk(q)
         k = rope_bk(k)
-        k_pool = pool["k"][li].at[blk, :, off].set(
-            k.reshape(b * kk, cfg.n_kv_heads, hd)
+        upd = _paged_pool_write(
+            pool, li, blk, off,
+            k.reshape(b * kk, cfg.n_kv_heads, hd),
+            v.reshape(b * kk, cfg.n_kv_heads, hd),
         )
-        v_pool = pool["v"][li].at[blk, :, off].set(
-            v.reshape(b * kk, cfg.n_kv_heads, hd)
-        )
-        new_k.append(k_pool)
-        new_v.append(v_pool)
+        for key, arr in upd.items():
+            new_pool[key].append(arr)
         ctx = paged_decode_attention(
             q.reshape(b * kk, cfg.n_heads, hd),
-            k_pool,
-            v_pool,
+            upd["k"],
+            upd["v"],
             tables_flat,
             lengths,
             tp=tp,
+            k_scale=upd.get("k_scale"),
+            v_scale=upd.get("v_scale"),
         )  # [B*K, H, D]
         h = h + (ctx.reshape(b, kk, -1) @ layer["wo"]).astype(h.dtype)
         x = rms_norm(h, layer["ffn_norm"], cfg.norm_eps)
@@ -648,7 +718,7 @@ def decode_block_paged(
         .reshape(b, kk, -1)
         .astype(jnp.float32)
     )
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return logits, {key: jnp.stack(arrs) for key, arrs in new_pool.items()}
 
 
 def decode_step(

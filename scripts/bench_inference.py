@@ -37,6 +37,9 @@ CFG = tfm.TransformerConfig(
 )
 N_REQ = 8
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", 64))
+# BENCH_KV_DTYPE=int8 stores the paged pool quantized (halved KV HBM:
+# the pressure phase fits ~2x the blocks in the same budget)
+KV_DTYPE = os.environ.get("BENCH_KV_DTYPE") or None
 # which phases to run (comma list); smoke runs can pick one
 PHASES = set(
     os.environ.get(
@@ -170,6 +173,7 @@ def main():
                 max_slots=N_REQ,
                 max_len=256,
                 chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+                kv_dtype=KV_DTYPE,
             ).start()
         )
         ratio = f" -> {serial_s / engine_s:.2f}x serial" if serial_s else ""
@@ -199,6 +203,7 @@ def main():
                 draft_params=params,
                 draft_cfg=CFG,
                 spec_k=int(os.environ.get("BENCH_SPEC_K", 4)),
+                kv_dtype=KV_DTYPE,
             ).start()
         )
         # st holds TIMED-WAVE deltas (the compile wave runs the same
@@ -245,6 +250,7 @@ def main():
             max_len=512,
             chunk_max=4,
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
+            kv_dtype=KV_DTYPE,
         ).start()
         try:
             warm = engine.submit(prompts[0], 16)
@@ -317,6 +323,7 @@ def main():
             "requests": N_REQ,
             "prefill_chunk": int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
             "paged_kv_block": 64,
+            "kv_dtype": KV_DTYPE or "bf16/f32 (model dtype)",
         },
     }
     print(json.dumps(result))
@@ -341,6 +348,16 @@ def _pressure_phase(params, rng) -> dict:
     blocks_per_slot = p_len // p_block
     # half of full demand (+1 scratch block 0)
     p_blocks = 1 + (p_slots * blocks_per_slot) // 2
+    if KV_DTYPE == "int8":
+        # hold the HBM BUDGET fixed, not the block count: int8 halves
+        # the K/V payload (+ f32 scales, whose [Hkv, bs] plane pads to
+        # the (8,128) tile), so the same bytes hold more blocks — the
+        # capacity win the artifact should show as fewer preemptions
+        hkv, d = CFG.n_kv_heads, CFG.head_dim
+        bf16_block = 2 * hkv * p_block * d * 2
+        pad_bs = -(-p_block // 128) * 128  # scale lane-dim tile padding
+        int8_block = 2 * hkv * p_block * d + 2 * hkv * pad_bs * 4
+        p_blocks = 1 + ((p_blocks - 1) * bf16_block) // int8_block
     if p_blocks < 1 + blocks_per_slot:
         raise SystemExit(
             f"[inf-bench] BENCH_PRESSURE_SLOTS={p_slots} too small: the "
@@ -367,6 +384,7 @@ def _pressure_phase(params, rng) -> dict:
         chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
         block_size=p_block,
         n_blocks=p_blocks,
+        kv_dtype=KV_DTYPE,
     ).start()
     try:
         # compile wave: short generations, pool barely touched

@@ -816,3 +816,85 @@ def test_speculative_freezes_finished_sequences(params):
     flat0 = [r[0] for r in stats.accept_hist]
     assert -1 in flat0
     assert real_propose is speculative._draft_propose  # patch released
+
+
+def test_paged_decode_int8_pool_close_to_fp(params):
+    """decode_tokens_paged over an int8 pool: same tokens' logits within
+    quantization noise of the fp pool path, after identical prefill."""
+    b, t0, bs, mb = 2, 6, 8, 8
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(1, CFG.vocab_size, (b, t0)),
+        jnp.int32,
+    )
+    tables = jnp.asarray(
+        [[1 + i * mb + j for j in range(mb)] for i in range(b)], jnp.int32
+    )
+    logits = {}
+    for kv_dtype in (None, jnp.int8):
+        pool = tfm.init_paged_pool(CFG, 1 + b * mb, bs, kv_dtype=kv_dtype)
+        for i in range(b):
+            _, pool = tfm.prefill_chunk_paged(
+                params, pool, tables[i], prompt[i], jnp.asarray(0, jnp.int32), CFG
+            )
+        lg, pool = tfm.decode_tokens_paged(
+            params, pool, tables,
+            jnp.asarray([7, 3], jnp.int32),
+            jnp.asarray([t0, t0], jnp.int32),
+            CFG,
+        )
+        if kv_dtype == jnp.int8:
+            assert pool["k"].dtype == jnp.int8
+            assert pool["k_scale"].shape == pool["k"].shape[:-1]
+        logits[kv_dtype] = np.asarray(lg)
+    np.testing.assert_allclose(
+        logits[jnp.int8], logits[None], rtol=0.08, atol=0.08
+    )
+
+
+def test_engine_int8_kv_pool_end_to_end(params):
+    """kv_dtype="int8": the engine serves through the quantized pool —
+    prefill, chunked decode, preemption machinery all run; greedy output
+    on the TINY config survives the ~0.5% KV noise and equals the fp
+    reference (quantization can flip near-ties on larger models, which
+    is why the mode is opt-in; TINY's logit gaps are wide)."""
+    rng = np.random.default_rng(7)
+    requests = [
+        (list(rng.integers(1, CFG.vocab_size, size=plen)), n)
+        for plen, n in [(3, 8), (7, 5), (12, 4)]
+    ]
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, kv_dtype="int8"
+    ).start()
+    try:
+        handles = [engine.submit(p, n) for p, n in requests]
+        results = [h.result(timeout=120) for h in handles]
+    finally:
+        engine.stop()
+    for (prompt, n), got in zip(requests, results):
+        assert got == reference_generate(params, prompt, n)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        InferenceEngine(params, CFG, kv_dtype="int4")
+
+
+def test_engine_int8_kv_with_tp_mesh_and_pallas(params, monkeypatch):
+    """int8 pool + TP mesh + forced Pallas kernel (interpret): the
+    head-sharded scales ride the shard_map and outputs match greedy
+    reference — the full quantized serving stack in one pass."""
+    from devspace_tpu.ops import paged_attention as pa
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    monkeypatch.setenv("DEVSPACE_PALLAS", "1")
+    monkeypatch.setenv("DEVSPACE_PALLAS_INTERPRET", "1")
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, mesh=mesh, kv_dtype="int8"
+    ).start()
+    try:
+        reqs = [([5, 1, 4], 7), ([2, 2, 2, 2, 2], 5)]
+        handles = [engine.submit(p, n) for p, n in reqs]
+        results = [h.result(timeout=300) for h in handles]
+    finally:
+        engine.stop()
+    for (prompt, n), got in zip(reqs, results):
+        assert got == reference_generate(params, prompt, n)
+    assert pa.LAST_DISPATCH == {"impl": "pallas", "tp": True}

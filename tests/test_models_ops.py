@@ -545,3 +545,85 @@ def test_paged_decode_attention_under_tp_mesh(pallas_interpret, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5
     )
+
+
+def test_quantize_kv_roundtrip_bound():
+    """Symmetric per-vector int8: |dequant - x| <= scale/2 = amax/254."""
+    from devspace_tpu.ops.paged_attention import dequantize_kv, quantize_kv
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(5, 4, 32)).astype(np.float32)) * 3.0
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (5, 4)
+    back = dequantize_kv(q, scale, jnp.float32)
+    amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err <= amax / 254 + 1e-6).all()
+    # all-zero vectors survive (eps floor, no div-by-zero / NaN)
+    q0, s0 = quantize_kv(jnp.zeros((2, 3, 8)))
+    assert not np.isnan(np.asarray(s0)).any()
+    assert (np.asarray(q0) == 0).all()
+
+
+def test_paged_decode_attention_int8_kernel_matches_reference(pallas_interpret):
+    """The Pallas kernel's int8 branch (dequant-in-VMEM, dynamic head-row
+    scale pick) must match the gather reference's dequant exactly — both
+    dequantize to q's dtype with identical rounding."""
+    from devspace_tpu.ops.paged_attention import (
+        _paged_decode_pallas,
+        paged_decode_reference,
+        quantize_kv,
+    )
+
+    rng = np.random.default_rng(4)
+    B, H, Hkv, D = 4, 8, 2, 16
+    n_blocks, bs, MB = 9, 8, 3
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pool_kf = jnp.asarray(rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32))
+    pool_vf = jnp.asarray(rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32))
+    pk, ks = quantize_kv(pool_kf)
+    pv, vs = quantize_kv(pool_vf)
+    tables = jnp.asarray(rng.integers(0, n_blocks, size=(B, MB)), jnp.int32)
+    lengths = jnp.asarray([MB * bs, bs + 3, 1, 0], jnp.int32)
+    got = _paged_decode_pallas(q, pk, pv, tables, lengths, ks, vs)
+    ref = paged_decode_reference(q, pk, pv, tables, lengths, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(got[:3]), np.asarray(ref[:3]), rtol=2e-4, atol=2e-5
+    )
+    assert bool(jnp.all(got[3] == 0.0))
+    # and the int8 result approximates the full-precision attention
+    full = paged_decode_reference(q, pool_kf, pool_vf, tables, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got[:3]), np.asarray(full[:3]), rtol=0.15, atol=0.05
+    )
+
+
+def test_paged_decode_attention_int8_under_tp_mesh(pallas_interpret, monkeypatch):
+    """int8 pool + TP shard_map: scales are head-sharded alongside the
+    pools and each shard's kernel dequantizes its LOCAL heads."""
+    from devspace_tpu.ops import paged_attention as pa
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    monkeypatch.setenv("DEVSPACE_PALLAS", "1")
+    rng = np.random.default_rng(5)
+    B, H, Hkv, D = 4, 8, 4, 16
+    n_blocks, bs, MB = 9, 8, 3
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    pool_kf = jnp.asarray(rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32))
+    pool_vf = jnp.asarray(rng.normal(size=(n_blocks, Hkv, bs, D)).astype(np.float32))
+    pk, ks = pa.quantize_kv(pool_kf)
+    pv, vs = pa.quantize_kv(pool_vf)
+    tables = jnp.asarray(rng.integers(0, n_blocks, size=(B, MB)), jnp.int32)
+    lengths = jnp.asarray([MB * bs, bs + 3, 1, 5], jnp.int32)
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    got = jax.jit(
+        lambda *a: pa.paged_decode_attention(
+            a[0], a[1], a[2], a[3], a[4], tp=(mesh, "model"),
+            k_scale=a[5], v_scale=a[6],
+        )
+    )(q, pk, pv, tables, lengths, ks, vs)
+    assert pa.LAST_DISPATCH == {"impl": "pallas", "tp": True}
+    ref = pa.paged_decode_reference(q, pk, pv, tables, lengths, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
