@@ -27,6 +27,11 @@ Design, TPU-first:
   admitted between decode iterations, not between requests, so a long
   generation does not block a short one — per-slot positions make every
   slot's causal mask independent.
+- **Prefix caching** (vLLM-style, on by default): written full prompt
+  blocks are published under their exact token-prefix key; admissions
+  sharing the prefix reference the same pool blocks (refcounted, LRU
+  eviction when the allocator runs dry) and prefill starts at the first
+  uncached position. Lossless; shared blocks are never rewritten.
 - **Device-side sampling + chunked decode**: sampling (greedy or
   per-slot temperature) happens inside the jitted step, and up to
   ``chunk_max`` tokens are decoded per dispatch via ``lax.scan`` — one
@@ -47,6 +52,7 @@ import math
 import queue
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -180,6 +186,7 @@ class InferenceEngine:
         draft_cfg: Optional[tfm.TransformerConfig] = None,
         spec_k: int = 4,
         kv_dtype: Optional[str] = None,
+        prefix_cache: bool = True,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
@@ -207,10 +214,20 @@ class InferenceEngine:
 
         ``kv_dtype="int8"`` stores the paged pool quantized (per-token
         per-head scales; ops.paged_attention.quantize_kv): K/V HBM
-        halves, so the same budget holds ~2x the blocks — fewer
+        halves, so the same budget holds ~1.9x the blocks — fewer
         KV-pressure preemptions at the cost of ~0.5% quantization noise
         in attention reads. Outputs are no longer bit-identical to the
-        bf16 pool (greedy ties can flip), which is why it is opt-in."""
+        bf16 pool (greedy ties can flip), which is why it is opt-in.
+
+        ``prefix_cache`` (default on) shares full prompt blocks between
+        requests with a common prefix: admission points the slot table
+        at already-written pool blocks (refcounted) and prefill starts
+        at the first uncached position. Freed published blocks linger
+        as an LRU cache and are evicted only when the allocator runs
+        dry. LOSSLESS: cached K/V is exactly what recomputation would
+        produce (same tokens, same chunking, causal), and a shared
+        block is never written again — decode/prefill writes land only
+        in private blocks past the matched prefix."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -346,6 +363,17 @@ class InferenceEngine:
         self._free_blocks: list[int] = list(range(1, self.n_blocks))
         self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
         self._nalloc = [0] * max_slots  # allocated blocks per slot
+        # prefix cache (vLLM-style): full PROMPT blocks, once their K/V
+        # is written, are published under their exact token-prefix key;
+        # later admissions sharing the prefix point their tables at the
+        # SAME pool blocks (refcounted) and skip recomputing them. Keys
+        # are the literal token tuples — no hash-collision risk, host
+        # memory is a few KB per cached block at serving scale.
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._prefix_map: "OrderedDict[tuple, int]" = OrderedDict()
+        self._published: dict[int, tuple] = {}  # blk -> its key
+        self._block_refs: dict[int, int] = {}  # blk -> table references
+        self.prefix_hit_blocks = 0
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
         self._resume: list[Request] = []  # preempted, re-admit first
@@ -549,6 +577,8 @@ class InferenceEngine:
             "max_slots": self.max_slots,
             "free_blocks": len(self._free_blocks),
             "total_blocks": self.n_blocks - 1,
+            "prefix_cached_blocks": len(self._published),
+            "prefix_hit_blocks": self.prefix_hit_blocks,
             "queued": self.pending.qsize() + len(self._resume),
             "uptime_s": round(uptime, 1),
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
@@ -580,22 +610,87 @@ class InferenceEngine:
         """Blocks to add so slot covers logical positions [0, upto)."""
         return max(0, math.ceil(upto / self.block_size) - self._nalloc[slot_idx])
 
+    def _evictable(self) -> int:
+        """Published cache blocks no table references — reclaimable."""
+        return sum(
+            1 for b in self._published if self._block_refs.get(b, 0) == 0
+        )
+
+    def _pop_block(self) -> int:
+        """Take a block for private use: free list first, then evict the
+        least-recently-matched ref-0 cache entry. Caller must have
+        checked availability (free + evictable)."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        for key, blk in self._prefix_map.items():  # LRU order: oldest first
+            if self._block_refs.get(blk, 0) == 0:
+                del self._prefix_map[key]
+                del self._published[blk]
+                return blk
+        raise RuntimeError("allocator invariant: no block available")
+
     def _alloc(self, slot_idx: int, upto: int) -> bool:
-        """Grow slot's table to cover [0, upto). False if pool exhausted."""
+        """Grow slot's table to cover [0, upto). False if pool exhausted
+        (after reclaiming unreferenced prefix-cache blocks)."""
         need = self._blocks_needed(slot_idx, upto)
-        if need > len(self._free_blocks):
+        if need > len(self._free_blocks) + self._evictable():
             return False
         for _ in range(need):
-            blk = self._free_blocks.pop()
+            blk = self._pop_block()
+            self._block_refs[blk] = 1
             self._tables[slot_idx, self._nalloc[slot_idx]] = blk
             self._nalloc[slot_idx] += 1
         return True
 
     def _free_slot_blocks(self, slot_idx: int) -> None:
         n = self._nalloc[slot_idx]
-        self._free_blocks.extend(int(b) for b in self._tables[slot_idx, :n])
+        for b in (int(b) for b in self._tables[slot_idx, :n]):
+            refs = self._block_refs.get(b, 1) - 1
+            self._block_refs[b] = refs
+            if refs <= 0 and b not in self._published:
+                self._free_blocks.append(b)
+            # published ref-0 blocks stay resident as prefix cache until
+            # the allocator needs them (_pop_block eviction)
         self._tables[slot_idx, :] = 0
         self._nalloc[slot_idx] = 0
+
+    def _match_prefix(self, prompt: list) -> list:
+        """Longest run of already-cached full prompt blocks, capped so at
+        least ONE prompt token is left to prefill (its logits seed the
+        first generated token)."""
+        if not self.prefix_cache_enabled:
+            return []
+        matched = []
+        bs = self.block_size
+        for i in range((len(prompt) - 1) // bs):
+            key = tuple(prompt[: (i + 1) * bs])
+            blk = self._prefix_map.get(key)
+            if blk is None:
+                break
+            self._prefix_map.move_to_end(key)  # LRU touch
+            matched.append(blk)
+        return matched
+
+    def _publish_prefix_blocks(self, slot_idx: int) -> None:
+        """Make this slot's fully-written full prompt blocks matchable.
+        Called after each prefill chunk; a block is publishable once
+        prefill has passed its end (its K/V is final: later writes are
+        all at higher positions). First writer wins — a concurrently
+        computed duplicate stays private."""
+        if not self.prefix_cache_enabled:
+            return
+        slot = self.slots[slot_idx]
+        bs = self.block_size
+        n_full = min(slot.prefill_pos, len(slot.prompt)) // bs
+        for i in range(n_full):
+            blk = int(self._tables[slot_idx, i])
+            if blk in self._published:
+                continue  # already matchable (e.g. matched at admission)
+            key = tuple(slot.prompt[: (i + 1) * bs])
+            if key in self._prefix_map:
+                continue  # another block already holds this content
+            self._prefix_map[key] = blk
+            self._published[blk] = key
 
     def _decode_tables(self, include=None) -> jax.Array:
         """Block tables for a dispatch: slots outside ``include`` (default:
@@ -662,11 +757,15 @@ class InferenceEngine:
 
     def _reset_pool(self) -> None:
         """Fresh pool + allocator state (all failure paths share this —
-        the invariant must not fork)."""
+        the invariant must not fork). The prefix cache indexes CONTENT
+        of the lost pool, so it resets with it."""
         self.pool = self._fresh_pool()
         self._free_blocks = list(range(1, self.n_blocks))
         self._tables[:] = 0
         self._nalloc = [0] * self.max_slots
+        self._prefix_map.clear()
+        self._published.clear()
+        self._block_refs.clear()
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -694,12 +793,34 @@ class InferenceEngine:
         False (leaving the request queued) when the pool can't hold the
         prompt right now."""
         prompt = req.prompt_ids + req.tokens  # tokens: preempted resume
-        if not self._alloc(slot_idx, len(prompt)):
+        matched = self._match_prefix(prompt)
+        need = math.ceil(len(prompt) / self.block_size) - len(matched)
+        # availability must not count the matched blocks themselves: a
+        # ref-0 cached block we are about to reference is no longer
+        # evictable for the private-block pops
+        matched_set = set(matched)
+        avail = len(self._free_blocks) + sum(
+            1
+            for b in self._published
+            if self._block_refs.get(b, 0) == 0 and b not in matched_set
+        )
+        if need > avail:
             return False
+        # commit: reference matched blocks FIRST so the private-block
+        # pops below can never evict them
+        for i, blk in enumerate(matched):
+            self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+            self._tables[slot_idx, i] = blk
+        self._nalloc[slot_idx] = len(matched)
+        ok = self._alloc(slot_idx, len(prompt))
+        assert ok, "availability was checked above"
+        self.prefix_hit_blocks += len(matched)
         slot = self.slots[slot_idx]
         slot.req = req
         slot.prompt = prompt
-        slot.prefill_pos = 0
+        # skip straight past the cached prefix: its K/V is already in
+        # the pool; at least one prompt token remains (_match_prefix cap)
+        slot.prefill_pos = len(matched) * self.block_size
         slot.ready = False
         slot.draft_ready = False
         slot.length = len(prompt)
@@ -737,6 +858,7 @@ class InferenceEngine:
             jnp.asarray(offset, jnp.int32),
         )
         slot.prefill_pos = offset + real
+        self._publish_prefix_blocks(slot_idx)
         if slot.prefill_pos >= t:
             # prefill complete: first token from the last REAL position
             key = jax.random.PRNGKey(req.seed)

@@ -251,6 +251,11 @@ def main():
             chunk_max=4,
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
             kv_dtype=KV_DTYPE,
+            # this phase measures LONG-PROMPT admission contention; the
+            # warmup shares the long prompt's prefix, so default-on
+            # prefix caching would quietly skip ~2/3 of the measured
+            # prefill work
+            prefix_cache=False,
         ).start()
         try:
             warm = engine.submit(prompts[0], 16)
