@@ -105,6 +105,20 @@ class Request:
     seed: int = 0
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0  # >= 1 = disabled
+    # token-id sequences that end generation; the matched suffix is
+    # stripped from result() (stream() may have already yielded it)
+    stop: Optional[list[list[int]]] = None
+    # EOS (and stop sequences) are ignored until this many tokens have
+    # been generated; EOS is additionally suppressed DEVICE-side so the
+    # model keeps producing real tokens instead of repeated EOS
+    min_new_tokens: int = 0
+    # token id -> additive logit bias, applied before sampling every
+    # generated token (use -inf/+inf floats to forbid/force tokens)
+    logit_bias: Optional[dict[int, float]] = None
+    # set at finish when a stop-sequence match is stripped: result()
+    # slices to this length; ``tokens`` itself is never shrunk because a
+    # stream() consumer in another thread may be mid-iteration over it
+    result_len: Optional[int] = None
     # filled by the engine
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -115,6 +129,8 @@ class Request:
             raise TimeoutError("generation did not finish in time")
         if self.error:
             raise RuntimeError(self.error)
+        if self.result_len is not None:
+            return self.tokens[: self.result_len]
         return self.tokens
 
     def stream(self, timeout: Optional[float] = None, poll: float = 0.02):
@@ -398,6 +414,15 @@ class InferenceEngine:
         # dispatch (lax.scan), so the host pays one round-trip per chunk.
         self.chunk_max = max(1, int(chunk_max))
         self._keys = jnp.zeros((max_slots, 2), jnp.uint32)
+        # per-slot sampling extras, resident on device and updated only
+        # at admission (and only for slots that use them — see
+        # _sync_sampling_extras): EOS id for device-side min-length
+        # suppression, the position below which EOS is suppressed, and
+        # an additive logit bias row per slot
+        self._eos_ids = jnp.full((max_slots,), -1, jnp.int32)
+        self._min_until = jnp.zeros((max_slots,), jnp.int32)
+        self._logit_bias = jnp.zeros((max_slots, cfg.vocab_size), jnp.float32)
+        self._extras_dirty = [False] * max_slots
 
         def decode_chunk(
             params,
@@ -409,6 +434,9 @@ class InferenceEngine:
             top_ks,
             top_ps,
             keys,
+            eos_ids,
+            min_until,
+            logit_bias,
             n_steps,
             use_filters,
         ):
@@ -417,6 +445,17 @@ class InferenceEngine:
                 logits, pool = tfm.decode_tokens_paged(
                     params, pool, tables, tok, pos, cfg, tp=self._tp
                 )
+                # sampling extras: additive bias, then EOS suppression
+                # for slots that haven't reached min_new_tokens (pos is
+                # the position being written = prompt_len-1+generated)
+                logits = logits + logit_bias
+                vocab_iota = jax.lax.broadcasted_iota(
+                    jnp.int32, logits.shape, 1
+                )
+                suppress = (pos < min_until)[:, None] & (
+                    vocab_iota == eos_ids[:, None]
+                )
+                logits = jnp.where(suppress, -jnp.inf, logits)
                 split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
                 keys, subs = split[:, 0], split[:, 1]
                 if use_filters:
@@ -524,6 +563,9 @@ class InferenceEngine:
         seed: int = 0,
         top_k: int = 0,
         top_p: float = 1.0,
+        stop: Optional[list[list[int]]] = None,
+        min_new_tokens: int = 0,
+        logit_bias: Optional[dict[int, float]] = None,
     ) -> Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -536,6 +578,19 @@ class InferenceEngine:
             )
         if top_k < 0 or top_p <= 0.0:
             raise ValueError("need top_k >= 0 and top_p > 0 (>= 1 disables)")
+        if stop is not None:
+            stop = [list(map(int, s)) for s in stop]
+            if not stop or any(not s for s in stop):
+                raise ValueError("stop must be non-empty token-id sequences")
+        if not 0 <= min_new_tokens <= max_new_tokens:
+            raise ValueError(
+                "need 0 <= min_new_tokens <= max_new_tokens"
+            )
+        if logit_bias is not None:
+            vocab = self.cfg.vocab_size
+            logit_bias = {int(t): float(b) for t, b in logit_bias.items()}
+            if any(not 0 <= t < vocab for t in logit_bias):
+                raise ValueError(f"logit_bias token ids must be in [0, {vocab})")
         req = Request(
             list(prompt_ids),
             int(max_new_tokens),
@@ -544,6 +599,9 @@ class InferenceEngine:
             seed,
             top_k=int(top_k),
             top_p=float(top_p),
+            stop=stop,
+            min_new_tokens=int(min_new_tokens),
+            logit_bias=logit_bias,
         )
         with self._submit_lock:
             if self._stop.is_set():
@@ -826,7 +884,43 @@ class InferenceEngine:
         slot.length = len(prompt)
         slot.remaining = req.max_new_tokens - len(req.tokens)
         slot.admitted_at = time.monotonic()
+        self._sync_sampling_extras(slot_idx, req)
         return True
+
+    def _sync_sampling_extras(self, slot_idx: int, req: Request) -> None:
+        """Refresh this slot's device-side sampling extras (EOS
+        suppression bound + logit bias row). Skipped entirely — no
+        device dispatches — while neither the new request nor the slot's
+        previous occupant used them, so plain requests never pay the
+        admission round-trips."""
+        uses_min = req.eos_id is not None and req.min_new_tokens > 0
+        uses = uses_min or bool(req.logit_bias)
+        if not uses and not self._extras_dirty[slot_idx]:
+            return
+        eos = req.eos_id if uses_min else -1
+        # the device suppresses EOS while the WRITE position is below
+        # this bound: sampled token number g is generated at position
+        # len(prompt_ids)-2+g, and tokens 1..min_new must not be EOS
+        # (absolute positions, so preemption-resume keeps the bound)
+        min_until = (
+            len(req.prompt_ids) + req.min_new_tokens - 1 if uses_min else 0
+        )
+        self._eos_ids = self._eos_ids.at[slot_idx].set(eos)
+        self._min_until = self._min_until.at[slot_idx].set(min_until)
+        self._logit_bias = self._logit_bias.at[slot_idx].set(
+            self._bias_row(req)
+        )
+        self._extras_dirty[slot_idx] = uses
+
+    def _bias_row(self, req: Request) -> np.ndarray:
+        """The request's dense [vocab] additive-bias row — the ONE place
+        logit_bias becomes an array (device rows and the host-side
+        first-token sample must stay in lockstep)."""
+        bias = np.zeros(self.cfg.vocab_size, np.float32)
+        if req.logit_bias:
+            for t, b in req.logit_bias.items():
+                bias[t] = b
+        return bias
 
     def _prefill_one_chunk(self, slot_idx: int) -> None:
         """Advance one slot's prefill by at most ``prefill_chunk`` tokens
@@ -868,8 +962,20 @@ class InferenceEngine:
                 key = jax.random.fold_in(key, len(req.tokens))
             key, sub = jax.random.split(key)
             self._keys = self._keys.at[slot_idx].set(key)
+            lg = logits[real - 1]
+            # the first generated token samples host-side, so the
+            # device-side extras must be mirrored here
+            if req.logit_bias:
+                lg = lg + self._bias_row(req)
+            # gen-so-far < min_new (NOT min_new >= 1: on preemption-
+            # resume the request may already be past its minimum)
+            if (
+                req.eos_id is not None
+                and len(req.tokens) < req.min_new_tokens
+            ):
+                lg = lg.at[req.eos_id].set(-jnp.inf)
             first = sample_logits(
-                sub, logits[real - 1], req.temperature, req.top_k, req.top_p
+                sub, lg, req.temperature, req.top_k, req.top_p
             )
             if self.draft_params is not None and req.temperature <= 0:
                 self._draft_prefill(slot_idx)
@@ -953,9 +1059,26 @@ class InferenceEngine:
         slot.last_token = token
         slot.length += 1
         slot.remaining -= 1
-        if slot.remaining <= 0 or (
-            req.eos_id is not None and token == req.eos_id
+        gen = len(req.tokens)
+        finish = slot.remaining <= 0
+        # EOS/stop never end generation inside the first min_new_tokens
+        # (EOS is additionally suppressed device-side so the model keeps
+        # producing real tokens there)
+        if (
+            req.eos_id is not None
+            and token == req.eos_id
+            and gen > req.min_new_tokens
         ):
+            finish = True
+        # checked even when max_new_tokens finishes on this same token —
+        # a match ending here still strips (result() contract)
+        if req.stop and gen > req.min_new_tokens:
+            for s in req.stop:
+                if gen >= len(s) and req.tokens[-len(s):] == s:
+                    req.result_len = gen - len(s)
+                    finish = True
+                    break
+        if finish:
             slot.req = None
             slot.ready = False
             self._free_slot_blocks(slot_idx)
@@ -1046,6 +1169,15 @@ class InferenceEngine:
                     if self.slots[i].req.temperature <= 0
                     and self.slots[i].draft_ready
                     and self.slots[i].length + self.spec_k <= self.max_len
+                    # the spec round samples without the per-slot extras:
+                    # biased slots would commit unbiased tokens, and
+                    # min-length slots could commit suppressed EOS — both
+                    # take the plain path (which applies them) instead
+                    # (truthiness: an empty logit_bias dict is a no-op
+                    # and must not disqualify the slot)
+                    and not self.slots[i].req.logit_bias
+                    and len(self.slots[i].req.tokens)
+                    >= self.slots[i].req.min_new_tokens
                 ]
             plain = [i for i in ready if i not in spec_idx]
             # Plain chunk size: sized to the LONGEST remaining want
@@ -1168,6 +1300,9 @@ class InferenceEngine:
                     top_ks,
                     top_ps,
                     self._keys,
+                    self._eos_ids,
+                    self._min_until,
+                    self._logit_bias,
                 )
                 toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
                 for i in plain:

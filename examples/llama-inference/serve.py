@@ -124,6 +124,9 @@ class Server:
         eos_id=None,
         top_k=0,
         top_p=1.0,
+        stop=None,
+        min_new_tokens=0,
+        logit_bias=None,
     ):
         req = self.engine.submit(
             prompt_ids,
@@ -132,6 +135,9 @@ class Server:
             eos_id=eos_id,
             top_k=top_k,
             top_p=top_p,
+            stop=stop,
+            min_new_tokens=min_new_tokens,
+            logit_bias=logit_bias,
         )
         return req.result(timeout=600)
 
@@ -182,7 +188,8 @@ def main():
                     unsupported = [
                         f
                         for f in (
-                            "temperature", "eos_id", "top_k", "top_p", "stream"
+                            "temperature", "eos_id", "top_k", "top_p",
+                            "stream", "stop", "min_new_tokens", "logit_bias",
                         )
                         if f in req
                     ]
@@ -224,6 +231,13 @@ def main():
                     ),
                     top_k=int(req.get("top_k", 0)),
                     top_p=float(req.get("top_p", 1.0)),
+                    stop=req.get("stop"),
+                    min_new_tokens=int(req.get("min_new_tokens", 0)),
+                    logit_bias=(
+                        {int(t): float(b) for t, b in req["logit_bias"].items()}
+                        if req.get("logit_bias")
+                        else None
+                    ),
                 )
                 prompt = req["prompt_ids"]
                 n = int(req.get("max_new_tokens", 16))

@@ -1007,3 +1007,125 @@ def test_prefix_cache_preemption_resume_rematches(params):
     # the resumed request must have RE-MATCHED its own published prompt
     # blocks (16-token prompts publish 2 full 8-token blocks each)
     assert st["prefix_hit_blocks"] > 0
+
+
+def test_stop_sequences_end_generation_and_are_stripped(params):
+    """A request with stop sequences ends when the generated suffix
+    matches one; the matched suffix is excluded from result()."""
+    prompt = [5, 1, 4]
+    full = reference_generate(params, prompt, 10)
+    # stop at the 4th generated token: single- and multi-token stops
+    for stop in ([[full[3]]], [full[2:4]], [[999], full[2:4]]):
+        engine = InferenceEngine(params, CFG, max_slots=2, max_len=32).start()
+        try:
+            got = engine.submit(prompt, 10, stop=stop).result(timeout=120)
+        finally:
+            engine.stop()
+        cut = 4 - len(stop[-1]) if stop[-1] == full[2:4] else 3
+        assert got == full[:cut], (stop, got, full)
+
+
+def test_stop_sequence_ignored_before_min_new_tokens(params):
+    prompt = [5, 1, 4]
+    full = reference_generate(params, prompt, 10)
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=32).start()
+    try:
+        # the stop token appears at generated index 3 (gen=4 <= min 6):
+        # generation must run on to max_new_tokens
+        got = engine.submit(
+            prompt, 8, stop=[[full[3]]], min_new_tokens=6
+        ).result(timeout=120)
+    finally:
+        engine.stop()
+    # a LATER re-occurrence may legitimately stop it after min; at
+    # minimum the early match must not have fired
+    assert len(got) >= 6
+
+
+def test_min_new_tokens_suppresses_eos(params):
+    """With eos_id set to the would-be first token, min_new_tokens keeps
+    generation alive (device-side suppression picks the runner-up) and
+    none of the first min_new tokens is EOS."""
+    prompt = [5, 1, 4]
+    first = reference_generate(params, prompt, 1)[0]
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=32).start()
+    try:
+        bare = engine.submit(prompt, 8, eos_id=first).result(timeout=120)
+        held = engine.submit(
+            prompt, 8, eos_id=first, min_new_tokens=5
+        ).result(timeout=120)
+    finally:
+        engine.stop()
+    assert bare == [first]  # sanity: eos fires immediately without min
+    assert len(held) >= 5
+    assert first not in held[:5]
+
+
+def test_logit_bias_forces_and_forbids(params):
+    prompt = [5, 1, 4]
+    free = reference_generate(params, prompt, 6)
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=32).start()
+    try:
+        forced = engine.submit(
+            prompt, 6, logit_bias={17: 1e9}
+        ).result(timeout=120)
+        forbidden = engine.submit(
+            prompt, 6, logit_bias={free[0]: float("-inf")}
+        ).result(timeout=120)
+        with pytest.raises(ValueError, match="logit_bias"):
+            engine.submit(prompt, 4, logit_bias={CFG.vocab_size: 1.0})
+    finally:
+        engine.stop()
+    assert forced == [17] * 6  # +1e9 swamps everything, every step
+    assert forbidden[0] != free[0]
+    assert free[0] not in forbidden  # greedy never picks -inf
+
+
+def test_sampling_extras_clean_slot_reuse(params):
+    """A biased request followed by a plain one in the same slot: the
+    stale bias row must be cleared (dirty-tracking path), restoring
+    reference-exact output."""
+    prompt = [5, 1, 4]
+    ref = reference_generate(params, prompt, 6)
+    engine = InferenceEngine(params, CFG, max_slots=1, max_len=32).start()
+    try:
+        engine.submit(prompt, 6, logit_bias={17: 1e9}).result(timeout=120)
+        got = engine.submit(prompt, 6).result(timeout=120)
+    finally:
+        engine.stop()
+    assert got == ref
+
+
+def test_sampling_extras_with_speculative_engine(params):
+    """Slots using logit_bias/min_new fall back to the plain decode path
+    under a spec engine — outputs still honor the extras, and plain
+    requests keep speccing."""
+    prompt = [5, 1, 4]
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=32,
+        draft_params=params, draft_cfg=CFG, spec_k=3,
+    ).start()
+    try:
+        forced = engine.submit(
+            prompt, 5, logit_bias={17: 1e9}
+        ).result(timeout=120)
+        plain = engine.submit(prompt, 5).result(timeout=120)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert forced == [17] * 5
+    assert plain == reference_generate(params, prompt, 5)
+    assert st["spec_rounds"] > 0  # the plain request still took spec
+
+
+def test_stop_sequence_on_final_token_still_strips(params):
+    """A stop match completing exactly on the max_new_tokens-th token
+    must still strip (the finish reasons coincide)."""
+    prompt = [5, 1, 4]
+    full = reference_generate(params, prompt, 4)
+    engine = InferenceEngine(params, CFG, max_slots=1, max_len=32).start()
+    try:
+        got = engine.submit(prompt, 4, stop=[full[2:4]]).result(timeout=120)
+    finally:
+        engine.stop()
+    assert got == full[:2]
