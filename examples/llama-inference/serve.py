@@ -275,8 +275,9 @@ def main():
             except Exception:  # noqa: BLE001
                 self._json(500, {"error": "internal server error"})
 
-    print("serving on :8000")
-    http.server.ThreadingHTTPServer(("0.0.0.0", 8000), Handler).serve_forever()
+    port = int(os.environ.get("PORT", 8000))
+    print(f"serving on :{port}")
+    http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler).serve_forever()
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ def _post(url, body, timeout=240):
 
 @pytest.mark.slow
 def test_serving_example_http_end_to_end():
+    port = 18473  # dedicated port: also exercises the PORT env var
     env = dict(
         os.environ,
         PYTHONPATH=REPO,
@@ -39,6 +40,7 @@ def test_serving_example_http_end_to_end():
         MODEL="tiny",
         MAX_SLOTS="2",
         SPEC_K="2",
+        PORT=str(port),
     )
     proc = subprocess.Popen(
         [sys.executable, SERVE],
@@ -47,20 +49,20 @@ def test_serving_example_http_end_to_end():
         text=True,
         env=env,
     )
-    base = "http://127.0.0.1:8000"
+    base = f"http://127.0.0.1:{port}"
     try:
         # wait for the port (server compiles nothing until first request)
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             try:
-                with socket.create_connection(("127.0.0.1", 8000), timeout=1):
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
                     break
             except OSError:
                 if proc.poll() is not None:
                     pytest.fail(f"server died: {proc.stdout.read()[-2000:]}")
                 time.sleep(0.3)
         else:
-            pytest.fail("server never opened :8000")
+            pytest.fail(f"server never opened :{port}")
 
         with urllib.request.urlopen(base + "/healthz", timeout=60) as resp:
             health = json.loads(resp.read())
