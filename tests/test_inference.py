@@ -1129,3 +1129,54 @@ def test_stop_sequence_on_final_token_still_strips(params):
     finally:
         engine.stop()
     assert got == full[:2]
+
+
+def test_engine_full_feature_matrix_stress(params):
+    """Everything at once: int8 KV pool + prefix caching + speculative
+    engine + an oversubscribed pool (preemption) + mixed per-request
+    sampling extras. Greedy requests must still match the quantized-pool
+    engine's own deterministic behavior (self-consistency across two
+    runs), every request completes, and no blocks leak."""
+    rng = np.random.default_rng(42)
+    shared = list(rng.integers(1, CFG.vocab_size, size=16))
+    reqs = [
+        dict(prompt_ids=shared + [7], max_new_tokens=12),
+        dict(prompt_ids=shared + [9], max_new_tokens=10,
+             stop=[[3]], min_new_tokens=4),
+        dict(prompt_ids=list(rng.integers(1, CFG.vocab_size, size=5)),
+             max_new_tokens=8, logit_bias={11: 1e9}),
+        dict(prompt_ids=shared + [2, 2], max_new_tokens=12),
+        dict(prompt_ids=[4, 4, 4], max_new_tokens=6, temperature=0.7,
+             seed=7, top_k=40),
+    ]
+
+    def run():
+        engine = InferenceEngine(
+            params, CFG, max_slots=2, max_len=48, block_size=8,
+            n_blocks=13,  # forces contention across 5 requests
+            kv_dtype="int8",
+            draft_params=params, draft_cfg=CFG, spec_k=2,
+        ).start()
+        try:
+            handles = [engine.submit(**r) for r in reqs]
+            outs = [h.result(timeout=600) for h in handles]
+            st = engine.stats()
+        finally:
+            engine.stop()
+        return outs, st
+
+    outs1, st1 = run()
+    outs2, st2 = run()
+    assert outs1[2] == [11] * 8  # bias forced through the feature pile
+    # greedy requests are deterministic under the full feature matrix
+    for a, b, r in zip(outs1, outs2, reqs):
+        if r.get("temperature", 0.0) <= 0:
+            assert a == b, r
+    for o, r in zip(outs1, reqs):
+        assert 1 <= len(o) <= r["max_new_tokens"]
+    assert st1["requests_completed"] == len(reqs)
+    assert st1["requests_failed"] == 0
+    assert (
+        st1["free_blocks"] + st1["prefix_cached_blocks"]
+        == st1["total_blocks"]
+    ), "leaked blocks under the full feature matrix"
