@@ -275,17 +275,6 @@ class InferenceEngine:
 
             from .quantization import QuantizedLinear
 
-            if any(
-                isinstance(leaf, QuantizedLinear)
-                for leaf in jax.tree_util.tree_leaves(
-                    params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
-                )
-            ):
-                raise ValueError(
-                    "tensor-parallel serving does not yet compose with "
-                    "int8-quantized params — pass dense params with mesh, "
-                    "or quantized params without"
-                )
             if Hkv % mesh.shape[model_axis]:
                 raise ValueError(
                     f"n_kv_heads {Hkv} not divisible by mesh axis "
@@ -297,11 +286,33 @@ class InferenceEngine:
                 5: NamedSharding(mesh, P(None, None, model_axis, None, None)),
                 4: NamedSharding(mesh, P(None, None, model_axis, None)),
             }
-            self.params = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-                params,
-                tfm.param_partition_spec(cfg, model_axis=model_axis),
-            )
+
+            def _place(p, s):
+                # weight-only int8 composes with TP: the int8 matrix
+                # shards exactly like the dense weight it replaces, and
+                # the per-output-channel scale shards on the OUT dim's
+                # axis (replicated when the out dim is) — the dequant
+                # multiply then stays local to each shard and the
+                # surrounding collective pattern is unchanged
+                if isinstance(p, QuantizedLinear):
+                    out_axis = s[1] if len(s) > 1 else None
+                    return QuantizedLinear(
+                        jax.device_put(p.q, NamedSharding(mesh, s)),
+                        jax.device_put(
+                            p.scale, NamedSharding(mesh, P(out_axis))
+                        ),
+                    )
+                return jax.device_put(p, NamedSharding(mesh, s))
+
+            def _shard_params(tree, tree_cfg):
+                return jax.tree_util.tree_map(
+                    _place,
+                    tree,
+                    tfm.param_partition_spec(tree_cfg, model_axis=model_axis),
+                    is_leaf=lambda x: isinstance(x, QuantizedLinear),
+                )
+
+            self.params = _shard_params(params, cfg)
             if draft_params is not None:
                 if draft_cfg is None:
                     raise ValueError("draft_params requires draft_cfg")
@@ -311,11 +322,7 @@ class InferenceEngine:
                         f"divisible by mesh axis '{model_axis}' "
                         f"({mesh.shape[model_axis]})"
                     )
-                draft_params = jax.tree_util.tree_map(
-                    lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-                    draft_params,
-                    tfm.param_partition_spec(draft_cfg, model_axis=model_axis),
-                )
+                draft_params = _shard_params(draft_params, draft_cfg)
 
         def fresh_pool():
             pool = tfm.init_paged_pool(

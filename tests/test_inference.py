@@ -157,13 +157,31 @@ def test_weight_only_int8_quantization(params):
     assert got == [int(t) for t in q_ref[0]]
 
 
-def test_engine_rejects_quantized_with_mesh(params):
+def test_engine_quantized_with_mesh_matches_single_device(params):
+    """Weight-only int8 now composes with tensor-parallel serving: the
+    int8 matrices shard like their dense counterparts and the per-output
+    -channel scales shard on the out dim — TP outputs must equal the
+    single-device quantized engine's (greedy self-consistency)."""
     from devspace_tpu.inference.quantization import quantize_params
     from devspace_tpu.parallel.mesh import create_mesh
 
+    q_params = quantize_params(params)
+    reqs = [([5, 1, 4], 7), ([2, 2, 2, 2, 2], 5)]
+
+    def run(mesh):
+        engine = InferenceEngine(
+            q_params, CFG, max_slots=2, max_len=64, mesh=mesh
+        ).start()
+        try:
+            return [
+                engine.submit(p, n).result(timeout=300) for p, n in reqs
+            ]
+        finally:
+            engine.stop()
+
+    single = run(None)
     mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="quantized"):
-        InferenceEngine(quantize_params(params), CFG, mesh=mesh)
+    assert run(mesh) == single
 
 
 def test_quantization_error_rejects_quantized_tree(params):
