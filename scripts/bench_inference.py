@@ -329,6 +329,7 @@ def main():
             "prefill_chunk": int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
             "paged_kv_block": 64,
             "kv_dtype": KV_DTYPE or "bf16/f32 (model dtype)",
+            "chunk_max": int(os.environ.get("BENCH_CHUNK", 8)),
         },
     }
     print(json.dumps(result))
