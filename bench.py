@@ -626,40 +626,61 @@ def run_resnet_isolated(notes: list[str]) -> tuple[float, str, str]:
 
 
 def run_lm_isolated(notes: list[str], resnet_platform: str) -> tuple[float, float, str]:
-    """LM bench in a child process (same wedge-protection rationale as
-    run_resnet_isolated; TPU work must also never overlap the resnet
-    child — see docs/PERF.md on single-chip contention). Skipped outright
-    when the remaining budget can't cover it. When the resnet leg already
-    proved the accelerator unusable, the LM child goes straight to CPU
-    instead of burning its whole timeout re-discovering the wedge."""
-    timeout = min(LM_TIMEOUT_S, max(remaining_budget() - 60.0, 0.0))
-    if timeout < min(90.0, LM_TIMEOUT_S):
-        notes.append("lm bench skipped (budget exhausted)")
-        log(f"[bench] lm bench skipped — {remaining_budget():.0f}s left")
-        return 0.0, 0.0, "none"
-    env_extra = {}
-    if resnet_platform not in ("tpu", "axon") and (
-        os.environ.get("JAX_PLATFORMS", "") != "cpu"
-    ):
+    """LM bench in a child with the SAME probe->retry->fallback machinery
+    as run_resnet_isolated (VERDICT r4 next #1: a single transient tunnel
+    error during warmup cost round 4 its LM/MFU record because this leg
+    was one-shot). TPU work must never overlap the resnet child — see
+    docs/PERF.md on single-chip contention — so this runs strictly after
+    it, which also means the resnet leg's platform verdict is fresh
+    evidence: when it just ran on the chip, no pre-attempt probe is
+    needed; when it proved the accelerator unusable, the LM child goes
+    straight to CPU instead of burning its timeout re-discovering the
+    wedge. On a failed first TPU attempt, ONE retry after a fresh probe
+    proves the chip came back; the CPU fallback and budget clamps close
+    the worst case."""
+    child_cmd = [sys.executable, os.path.abspath(__file__), "--lm-child"]
+
+    def attempt(env_extra: dict, cap: float, label: str) -> tuple[float, float, str] | None:
+        timeout = min(cap, max(remaining_budget() - 60.0, 0.0))
+        if timeout < min(90.0, cap):
+            notes.append(f"{label} skipped (budget exhausted)")
+            log(f"[bench] {label} skipped — {remaining_budget():.0f}s left")
+            return None
+        hb(f"{label} start (timeout {timeout:.0f}s)")
+        rc, stdout = run_child(child_cmd, timeout=timeout, env_extra=env_extra)
+        if rc is None:
+            notes.append(f"{label} timed out after {timeout:.0f}s")
+            log(f"[bench] {label} timed out after {timeout:.0f}s")
+            return None
+        for line in stdout:
+            if line.startswith("LM_RESULT "):
+                _, tok_s, tflops, platform = line.split()
+                return float(tok_s), float(tflops), platform
+        notes.append(f"{label} failed rc={rc}")
+        log(f"[bench] {label} failed (rc={rc})")
+        return None
+
+    on_accelerator = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    chip_proven = resnet_platform in ("tpu", "axon")
+    result = None
+    if on_accelerator and chip_proven:
+        # the resnet leg JUST ran on the chip in this invocation — the
+        # chip is proven alive, skip the pre-attempt probe
+        result = attempt({}, LM_TIMEOUT_S, "lm tpu attempt 1")
+        if result is None and remaining_budget() > 240.0:
+            # transient tunnel error? ONE retry, but only after a fresh
+            # probe proves the chip came back
+            if probe_accelerator(min(90.0, remaining_budget() - 120)):
+                result = attempt({}, LM_TIMEOUT_S, "lm tpu attempt 2")
+    elif on_accelerator:
         notes.append("lm on cpu (accelerator unusable per resnet leg)")
-        env_extra = {"JAX_PLATFORMS": "cpu"}
-    hb(f"lm child start (timeout {timeout:.0f}s)")
-    rc, stdout = run_child(
-        [sys.executable, os.path.abspath(__file__), "--lm-child"],
-        timeout=timeout,
-        env_extra=env_extra,
-    )
-    if rc is None:
-        notes.append(f"lm child timed out after {timeout:.0f}s")
-        log("[bench] lm child timed out")
-        return 0.0, 0.0, "none"
-    for line in stdout:
-        if line.startswith("LM_RESULT "):
-            _, tok_s, tflops, platform = line.split()
-            return float(tok_s), float(tflops), platform
-    notes.append(f"lm child failed rc={rc}")
-    log(f"[bench] lm child failed (rc={rc})")
-    return 0.0, 0.0, "none"
+    if result is None and on_accelerator:
+        if chip_proven:
+            log("[bench] lm accelerator capture failed — falling back to CPU")
+        result = attempt({"JAX_PLATFORMS": "cpu"}, CPU_TIMEOUT_S, "lm cpu fallback")
+    elif result is None:
+        result = attempt({}, CPU_TIMEOUT_S, "lm cpu")
+    return result or (0.0, 0.0, "none")
 
 
 def main() -> int:
@@ -717,9 +738,9 @@ def main() -> int:
         notes.append(f"resnet bench failed: {e}")
         log(f"[bench] resnet bench failed: {e}")
         imgs_per_sec, platform, device_kind = 0.0, "none", ""
-    lm_tok_s, lm_tflops, _lm_platform = 0.0, 0.0, "none"
+    lm_tok_s, lm_tflops, lm_platform = 0.0, 0.0, "none"
     try:
-        lm_tok_s, lm_tflops, _lm_platform = run_lm_isolated(notes, platform)
+        lm_tok_s, lm_tflops, lm_platform = run_lm_isolated(notes, platform)
     except Exception as e:  # noqa: BLE001
         notes.append(f"lm bench failed: {e}")
         log(f"[bench] lm bench failed: {e}")
@@ -766,7 +787,12 @@ def main() -> int:
         else None,
         "lm_train_tokens_per_sec": round(lm_tok_s, 0),
         "lm_model_tflops": round(lm_tflops, 1),
-        "lm_mfu_nominal_pct": round(100 * lm_tflops / peak, 1) if peak else None,
+        # MFU is only meaningful against the chip whose peak `peak` names:
+        # a CPU-fallback LM capture must not divide by the TPU peak
+        "lm_mfu_nominal_pct": round(100 * lm_tflops / peak, 1)
+        if peak and lm_platform in ("tpu", "axon")
+        else None,
+        "lm_platform": lm_platform,
         "sync_edit_to_slice_ms": round(sync_latency * 1000, 0)
         if sync_latency
         else None,

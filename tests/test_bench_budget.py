@@ -88,3 +88,111 @@ def test_bench_json_contract_keys():
     result, _, _ = run_bench({"DEVSPACE_BENCH_TOTAL_BUDGET": "1"}, timeout=120)
     for key in ("metric", "value", "unit", "vs_baseline", "status", "reason", "platform"):
         assert key in result, f"missing key {key}"
+
+
+# ---------------------------------------------------------------------------
+# LM-leg retry machinery (VERDICT r4 next #1): round 4's LM record was lost
+# to a single transient tunnel error because the leg was one-shot. These
+# unit tests drive run_lm_isolated directly with run_child/probe mocked —
+# no chip, no subprocess — and pin the probe->retry->fallback contract.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def bench_mod(monkeypatch):
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+
+    # plenty of budget unless a test narrows it
+    monkeypatch.setattr(bench, "remaining_budget", lambda: 900.0)
+    # the harness env forces cpu; these tests simulate an accelerator run
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    yield bench
+    sys.path.remove(os.path.dirname(BENCH))
+
+
+def test_lm_leg_retries_once_after_transient_failure(bench_mod, monkeypatch):
+    """First TPU attempt dies rc=1 (the round-4 failure), a fresh probe
+    passes, the retry succeeds — the number lands."""
+    calls = []
+
+    def fake_run_child(cmd, timeout, env_extra=None):
+        calls.append(dict(env_extra or {}))
+        if len(calls) == 1:
+            return 1, ["remote_compile: read body: response body closed"]
+        return 0, ["LM_RESULT 100.0 5.0 axon"]
+
+    probes = []
+    monkeypatch.setattr(bench_mod, "run_child", fake_run_child)
+    monkeypatch.setattr(
+        bench_mod, "probe_accelerator", lambda t: probes.append(t) or True
+    )
+    notes = []
+    tok_s, tflops, platform = bench_mod.run_lm_isolated(notes, "axon")
+    assert (tok_s, tflops, platform) == (100.0, 5.0, "axon")
+    assert len(calls) == 2 and calls == [{}, {}], "retry must stay on TPU"
+    assert len(probes) == 1, "exactly one fresh probe before the retry"
+    assert any("attempt 1 failed rc=1" in n for n in notes)
+
+
+def test_lm_leg_falls_back_to_cpu_when_retry_fails(bench_mod, monkeypatch):
+    """Both TPU attempts fail -> the CPU fallback still captures a number
+    (degraded, but the record is never empty)."""
+    calls = []
+
+    def fake_run_child(cmd, timeout, env_extra=None):
+        calls.append(dict(env_extra or {}))
+        if env_extra and env_extra.get("JAX_PLATFORMS") == "cpu":
+            return 0, ["LM_RESULT 7.0 0.1 cpu"]
+        return 1, []
+
+    monkeypatch.setattr(bench_mod, "run_child", fake_run_child)
+    monkeypatch.setattr(bench_mod, "probe_accelerator", lambda t: True)
+    notes = []
+    tok_s, tflops, platform = bench_mod.run_lm_isolated(notes, "axon")
+    assert (tok_s, platform) == (7.0, "cpu")
+    assert calls == [{}, {}, {"JAX_PLATFORMS": "cpu"}]
+
+
+def test_lm_leg_skips_tpu_when_resnet_proved_chip_dead(bench_mod, monkeypatch):
+    """When the resnet leg already proved the accelerator unusable, the LM
+    leg must not burn its timeout re-discovering the wedge."""
+    calls = []
+
+    def fake_run_child(cmd, timeout, env_extra=None):
+        calls.append(dict(env_extra or {}))
+        return 0, ["LM_RESULT 7.0 0.1 cpu"]
+
+    monkeypatch.setattr(bench_mod, "run_child", fake_run_child)
+    monkeypatch.setattr(
+        bench_mod,
+        "probe_accelerator",
+        lambda t: pytest.fail("no probe when going straight to CPU"),
+    )
+    notes = []
+    tok_s, _, platform = bench_mod.run_lm_isolated(notes, "cpu")
+    assert (tok_s, platform) == (7.0, "cpu")
+    assert calls == [{"JAX_PLATFORMS": "cpu"}]
+    assert any("unusable per resnet leg" in n for n in notes)
+
+
+def test_lm_leg_no_retry_when_budget_too_low(bench_mod, monkeypatch):
+    """A failed attempt with <240s left must not start a retry that the
+    global deadline would then wedge on."""
+    monkeypatch.setattr(bench_mod, "remaining_budget", lambda: 200.0)
+    calls = []
+
+    def fake_run_child(cmd, timeout, env_extra=None):
+        calls.append(dict(env_extra or {}))
+        return 1, []
+
+    monkeypatch.setattr(bench_mod, "run_child", fake_run_child)
+    monkeypatch.setattr(
+        bench_mod,
+        "probe_accelerator",
+        lambda t: pytest.fail("no probe when the budget can't fund a retry"),
+    )
+    notes = []
+    tok_s, _, platform = bench_mod.run_lm_isolated(notes, "axon")
+    # first TPU attempt + cpu fallback only, no retry in between
+    assert calls == [{}, {"JAX_PLATFORMS": "cpu"}]
