@@ -561,6 +561,57 @@ class InferenceEngine:
             self._draft_prefill_jit = jax.jit(draft_prefill, donate_argnums=1)
 
     # -- public api --------------------------------------------------------
+    @classmethod
+    def from_checkpoint(
+        cls,
+        path: str,
+        cfg,
+        *,
+        step: Optional[int] = None,
+        quantize: Optional[str] = None,
+        draft_checkpoint: Optional[str] = None,
+        draft_cfg=None,
+        draft_step: Optional[int] = None,
+        mesh=None,
+        model_axis: str = "model",
+        **engine_kwargs,
+    ) -> "InferenceEngine":
+        """The train->serve seam in one call: restore params from a
+        training checkpoint (inference/checkpoint.py — params-only
+        elastic restore, placed for this engine's topology, optionally
+        int8 weight-quantized via ``quantize="int8"``) and build the
+        engine. ``draft_checkpoint``/``draft_cfg`` restore a trained
+        draft model for speculative decoding the same way. Remaining
+        kwargs go to the constructor (call ``.start()`` as usual)."""
+        from .checkpoint import load_serving_params
+
+        params, _ = load_serving_params(
+            path, cfg, step=step, mesh=mesh, model_axis=model_axis,
+            quantize=quantize,
+        )
+        draft_params = None
+        if draft_checkpoint is None and draft_cfg is not None:
+            raise ValueError(
+                "draft_cfg without draft_checkpoint — from_checkpoint "
+                "restores draft weights, it cannot invent them"
+            )
+        if draft_checkpoint is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_checkpoint requires draft_cfg")
+            draft_params, _ = load_serving_params(
+                draft_checkpoint, draft_cfg, step=draft_step, mesh=mesh,
+                model_axis=model_axis,
+            )
+        return cls(
+            params,
+            cfg,
+            mesh=mesh,
+            model_axis=model_axis,
+            draft_params=draft_params,
+            draft_cfg=draft_cfg if draft_params is not None else None,
+            **engine_kwargs,
+        )
+
     def submit(
         self,
         prompt_ids: list[int],
@@ -687,12 +738,28 @@ class InferenceEngine:
         checked availability (free + evictable)."""
         if self._free_blocks:
             return self._free_blocks.pop()
+        victim = None
         for key, blk in self._prefix_map.items():  # LRU order: oldest first
             if self._block_refs.get(blk, 0) == 0:
-                del self._prefix_map[key]
-                del self._published[blk]
-                return blk
-        raise RuntimeError("allocator invariant: no block available")
+                victim = (key, blk)
+                break
+        if victim is None:
+            raise RuntimeError("allocator invariant: no block available")
+        key, blk = victim
+        del self._prefix_map[key]
+        del self._published[blk]
+        # every cached prefix extending the evicted key is now
+        # unmatchable (_match_prefix needs the full ancestor chain), so
+        # reclaim ref-0 descendants to the free list NOW and unpublish
+        # in-use ones so their release frees them — instead of dead
+        # cache blocks occupying pool space one _pop_block at a time
+        n = len(key)
+        for k2 in [k for k in self._prefix_map if len(k) > n and k[:n] == key]:
+            b2 = self._prefix_map.pop(k2)
+            del self._published[b2]
+            if self._block_refs.get(b2, 0) == 0:
+                self._free_blocks.append(b2)
+        return blk
 
     def _alloc(self, slot_idx: int, upto: int) -> bool:
         """Grow slot's table to cover [0, upto). False if pool exhausted
@@ -1078,10 +1145,17 @@ class InferenceEngine:
         ):
             finish = True
         # checked even when max_new_tokens finishes on this same token —
-        # a match ending here still strips (result() contract)
-        if req.stop and gen > req.min_new_tokens:
+        # a match ending here still strips (result() contract). A match
+        # only counts when the WHOLE matched sequence lies past
+        # min_new_tokens: a straddling match would strip result() below
+        # the guaranteed minimum, so generation continues instead.
+        if req.stop:
             for s in req.stop:
-                if gen >= len(s) and req.tokens[-len(s):] == s:
+                if (
+                    gen >= len(s)
+                    and gen - len(s) >= req.min_new_tokens
+                    and req.tokens[-len(s):] == s
+                ):
                     req.result_len = gen - len(s)
                     finish = True
                     break
@@ -1119,6 +1193,12 @@ class InferenceEngine:
                         break
                 except Exception as e:  # noqa: BLE001 — surface per-request
                     req.error = str(e)
+                    # _admit may have reserved blocks (and prefix-cache
+                    # refs) before raising — e.g. in the device work of
+                    # _sync_sampling_extras. Release them or the pool
+                    # shrinks permanently; idempotent when nothing was
+                    # reserved (_nalloc is 0).
+                    self._free_slot_blocks(i)
                     self.slots[i].req = None
                     self.requests_failed += 1
                     self._recover_pool_if_lost()

@@ -1198,3 +1198,79 @@ def test_engine_full_feature_matrix_stress(params):
         st1["free_blocks"] + st1["prefix_cached_blocks"]
         == st1["total_blocks"]
     ), "leaked blocks under the full feature matrix"
+
+
+def test_stop_match_never_strips_below_min_new_tokens(params):
+    """Advisor r4: a stop match whose END lies past min_new_tokens but
+    whose START does not (a straddling match) must not count — result()
+    guarantees at least min_new_tokens tokens. logit_bias forces every
+    generated token to A, so [A, A] first matches at gen=2 and straddles
+    until gen=5, the first match whose whole span lies past min=3."""
+    A = 7
+    engine = InferenceEngine(params, CFG, max_slots=1, max_len=64).start()
+    try:
+        out = engine.submit(
+            [1, 2], 10, stop=[[A, A]], min_new_tokens=3, logit_bias={A: 100.0}
+        ).result(timeout=120)
+    finally:
+        engine.stop()
+    assert out == [A, A, A]
+
+
+def test_admission_failure_frees_reserved_blocks(params):
+    """Advisor r4: _admit reserves blocks (and prefix-cache refs) BEFORE
+    its device work; a failure there must release them, or pool capacity
+    shrinks permanently. Inject a one-shot failure into
+    _sync_sampling_extras and check the allocator accounting plus that a
+    subsequent request still runs correctly."""
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=64)
+    orig = engine._sync_sampling_extras
+    armed = [True]
+
+    def flaky(slot_idx, req):
+        if armed[0]:
+            armed[0] = False
+            raise RuntimeError("injected admission failure")
+        return orig(slot_idx, req)
+
+    engine._sync_sampling_extras = flaky
+    engine.start()
+    try:
+        h1 = engine.submit([1, 2, 3, 4, 5], 4)
+        with pytest.raises(RuntimeError, match="injected"):
+            h1.result(timeout=120)
+        st = engine.stats()
+        assert (
+            st["free_blocks"] + st["prefix_cached_blocks"]
+            == st["total_blocks"]
+        ), "failed admission leaked pool blocks"
+        prompt = [5, 1, 4]
+        assert engine.submit(prompt, 6).result(
+            timeout=120
+        ) == reference_generate(params, prompt, 6)
+    finally:
+        engine.stop()
+
+
+def test_pop_block_reclaims_orphaned_chain_descendants(params):
+    """Advisor r4: evicting a chain-head cache block makes every longer
+    cached prefix unmatchable (_match_prefix needs the full ancestor
+    chain) — those descendants must return to the free list with it, not
+    linger as dead resident blocks reclaimed one _pop_block at a time."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, block_size=4
+    ).start()
+    try:
+        # 13-token prompt -> 3 full prompt blocks published as a chain
+        engine.submit(list(range(1, 14)), 2).result(timeout=120)
+    finally:
+        engine.stop()
+    assert len(engine._prefix_map) == 3
+    engine._free_blocks = []  # force the eviction path
+    engine._pop_block()  # LRU-oldest = the chain head
+    assert engine._prefix_map == {} and engine._published == {}, (
+        "orphaned descendants stayed published"
+    )
+    assert len(engine._free_blocks) == 2, (
+        "orphaned ref-0 descendants must be freed immediately"
+    )
