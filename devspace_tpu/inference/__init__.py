@@ -1,2 +1,3 @@
+from .checkpoint import load_serving_params  # noqa: F401
 from .engine import InferenceEngine, Request  # noqa: F401
 from .speculative import SpecStats, generate_speculative  # noqa: F401

@@ -1,0 +1,142 @@
+"""Serving-side checkpoint loading — the train -> serve seam.
+
+Training writes step-managed Orbax checkpoints of the full train state
+(``{"params", "opt_state", "step"}`` — training/checkpoint.py
+``CheckpointManager``); serving needs only the params. This loader
+restores the params SUBTREE alone (Orbax partial restore: the optimizer
+state, ~2x the param bytes under Adam, is never materialized), places it
+for the serving topology in the same restore (replicated on one chip, or
+tensor-parallel per ``models.transformer.param_partition_spec`` — the
+elastic cross-topology mechanism of
+``training/checkpoint.py:sharded_template``, so a checkpoint saved on an
+8-device training mesh serves on 1 chip or a different TP width), and
+optionally int8 weight-quantizes for bandwidth-bound decode.
+
+Reference parity: the reference's deploy engines consume the build
+pipeline's image artifact (``/root/reference/pkg/devspace/deploy/deploy.go``
+resolving images built by ``pkg/devspace/build``); here the artifact
+crossing the train->serve seam is the checkpoint directory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..training.checkpoint import list_step_dirs
+
+
+def _resolve_step_dir(path: str, step: Optional[int]) -> tuple[str, Optional[int]]:
+    """``path`` is either a training root full of ``step_NNNNNNNN`` dirs
+    (pick ``step`` or the latest) or one checkpoint dir directly."""
+    path = os.path.abspath(path)
+    steps = list_step_dirs(path)
+    if steps:
+        if step is None:
+            return steps[-1][1], steps[-1][0]
+        for s, p in steps:
+            if s == step:
+                return p, s
+        raise FileNotFoundError(
+            f"no step_{step:08d} under {path} "
+            f"(available steps: {[s for s, _ in steps]})"
+        )
+    if step is not None:
+        raise FileNotFoundError(
+            f"{path} contains no step_NNNNNNNN dirs to select step {step} from"
+        )
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    base = os.path.basename(path.rstrip(os.sep))
+    found = (
+        int(base[len("step_"):])
+        if base.startswith("step_") and base[len("step_"):].isdigit()
+        else None
+    )
+    return path, found
+
+
+def _params_template(cfg, mesh, model_axis: str, device):
+    """Abstract params tree (shapes/dtypes from the config — nothing
+    materialized) with every leaf annotated with its serving placement.
+    The explicit shardings are what make the restore elastic: Orbax reads
+    the logical arrays and lays them out per the template instead of
+    reproducing the training topology recorded in the checkpoint."""
+    from ..models import transformer as tfm
+    from ..training.checkpoint import sharded_template
+
+    shapes = jax.eval_shape(lambda: tfm.init_params(cfg, jax.random.PRNGKey(0)))
+    if mesh is not None:
+        specs = tfm.param_partition_spec(cfg, model_axis=model_axis)
+        return sharded_template(shapes, mesh, specs)
+    sharding = jax.sharding.SingleDeviceSharding(device or jax.devices()[0])
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
+        shapes,
+    )
+
+
+def _is_train_state(path: str) -> bool:
+    """Whether the checkpoint holds a full train state (restore the
+    ``params`` subtree) or a bare params tree. Metadata-only — no array
+    bytes are read. Unreadable metadata assumes the train-state layout
+    (the common case; a bare tree then fails restore with a clear error)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        md = ocp.PyTreeCheckpointer().metadata(path)
+        tree = md.item_metadata.tree
+        return isinstance(tree, dict) and "params" in tree
+    except Exception:  # noqa: BLE001 — metadata shape varies across versions
+        return True
+
+
+def load_serving_params(
+    path: str,
+    cfg,
+    step: Optional[int] = None,
+    mesh=None,
+    model_axis: str = "model",
+    device=None,
+    quantize: Optional[str] = None,
+) -> tuple[dict, Optional[int]]:
+    """Restore serving params from a training checkpoint.
+
+    ``path``: a training checkpoint root (``step_NNNNNNNN`` dirs — the
+    latest, or ``step``, is chosen) or one checkpoint dir. Accepts both a
+    full train state (params restored alone, optimizer state untouched)
+    and a bare params tree. ``mesh`` shards the restore tensor-parallel;
+    otherwise leaves land on ``device`` (default: the first device).
+    ``quantize="int8"`` applies weight-only int8
+    (inference/quantization.py) after restore. Returns ``(params, step)``
+    with ``step`` None when the directory name carries no step number.
+    """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+    resolved, found_step = _resolve_step_dir(path, step)
+    template = _params_template(cfg, mesh, model_axis, device)
+
+    from ..training.checkpoint import restore_checkpoint
+
+    try:
+        if _is_train_state(resolved):
+            params = restore_checkpoint(
+                resolved, {"params": template}, partial=True
+            )["params"]
+        else:
+            params = restore_checkpoint(resolved, template)
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # noqa: BLE001 — surface the seam, keep the cause
+        raise ValueError(
+            f"checkpoint at {resolved} does not match the serving config "
+            f"(wrong model config, or not a params/train-state "
+            f"checkpoint): {e}"
+        ) from e
+    if quantize == "int8":
+        from .quantization import quantize_params
+
+        params = quantize_params(params)
+    return params, found_step

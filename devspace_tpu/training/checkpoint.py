@@ -24,11 +24,16 @@ def save_checkpoint(path: str, state: Any, force: bool = True) -> None:
     _checkpointer().save(path, state, force=force)
 
 
-def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+def restore_checkpoint(
+    path: str, template: Optional[Any] = None, partial: bool = False
+) -> Any:
     """Restore; ``template`` controls structure AND placement. Leaves that
     are ShapeDtypeStructs WITH a sharding restore to that sharding (the
     elastic cross-topology path — see :func:`sharded_template`); without
-    shardings Orbax falls back to the layout recorded in the checkpoint."""
+    shardings Orbax falls back to the layout recorded in the checkpoint.
+    ``partial=True`` (needs a template) restores only the subtree the
+    template names — e.g. the params of a full train state, leaving the
+    optimizer state's bytes unread (the serving loader's path)."""
     path = os.path.abspath(path)
     if template is not None:
         import orbax.checkpoint as ocp
@@ -38,8 +43,12 @@ def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
         restore_args = ocp.checkpoint_utils.construct_restore_args(template)
         return _checkpointer().restore(
             path,
-            args=ocp.args.PyTreeRestore(template, restore_args=restore_args),
+            args=ocp.args.PyTreeRestore(
+                template, restore_args=restore_args, partial_restore=partial
+            ),
         )
+    if partial:
+        raise ValueError("partial restore needs a template naming the subtree")
     return _checkpointer().restore(path)
 
 

@@ -30,10 +30,32 @@ class Server:
         name = os.environ.get("MODEL", "tiny")
         self.cfg = CONFIGS[name]
         print(f"loading {name} ({self.cfg.n_layers} layers) on {jax.devices()[0]}")
-        # Real deployments restore from a checkpoint
-        # (devspace_tpu.training.checkpoint); random weights keep the
-        # example self-contained.
-        params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
+        # CHECKPOINT=<dir> restores trained weights (a training root of
+        # step_NNNNNNNN dirs or one checkpoint dir — the train->serve
+        # seam, devspace_tpu.inference.load_serving_params); without it,
+        # random weights keep the example self-contained. QUANTIZE=int8
+        # serves weight-only-quantized (decode is weight-bandwidth-bound).
+        ckpt = os.environ.get("CHECKPOINT")
+        quantize = os.environ.get("QUANTIZE") or None
+        if quantize and quantize != "int8":
+            raise SystemExit(f"QUANTIZE={quantize!r} (only int8 exists)")
+        if ckpt:
+            from devspace_tpu.inference import load_serving_params
+
+            params, step = load_serving_params(
+                ckpt, self.cfg, quantize=quantize
+            )
+            print(
+                f"restored {name} params from {ckpt}"
+                + (f" (step {step})" if step is not None else "")
+                + (f", {quantize} weights" if quantize else "")
+            )
+        else:
+            params = tfm.init_params(self.cfg, jax.random.PRNGKey(0))
+            if quantize:
+                from devspace_tpu.inference.quantization import quantize_params
+
+                params = quantize_params(params)
         self.params = params
         # Speculative decoding lives IN the engine (draft proposals are
         # verified against the paged KV pool, coexisting with continuous
@@ -68,7 +90,17 @@ class Server:
                     f"{self.cfg.vocab_size} — a draft must share the "
                     f"target's vocabulary"
                 )
-            draft_params = tfm.init_params(draft_cfg, jax.random.PRNGKey(1))
+            draft_ckpt = os.environ.get("DRAFT_CHECKPOINT")
+            if draft_ckpt:
+                from devspace_tpu.inference import load_serving_params
+
+                draft_params, dstep = load_serving_params(draft_ckpt, draft_cfg)
+                print(
+                    f"restored draft '{draft_name}' params from {draft_ckpt}"
+                    + (f" (step {dstep})" if dstep is not None else "")
+                )
+            else:
+                draft_params = tfm.init_params(draft_cfg, jax.random.PRNGKey(1))
         self.engine = InferenceEngine(
             params,
             self.cfg,

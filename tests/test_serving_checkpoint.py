@@ -1,0 +1,162 @@
+"""The train -> checkpoint -> serve seam (VERDICT r4 next #2): training
+writes step-managed Orbax checkpoints; ``load_serving_params`` /
+``InferenceEngine.from_checkpoint`` restore the params subtree alone into
+the serving engine — single-chip, tensor-parallel (elastic placement), or
+int8 weight-quantized — and generation must match serving the in-memory
+trained params."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from devspace_tpu.inference import InferenceEngine, load_serving_params
+from devspace_tpu.models import transformer as tfm
+from devspace_tpu.training.checkpoint import CheckpointManager, save_checkpoint
+from devspace_tpu.training.trainer import make_lm_train_step, train_loop
+
+CFG = tfm.TINY
+PROMPTS = [[5, 1, 4], [2, 2, 2, 2, 2]]
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Train TINY for 6 LM steps, checkpointing every 3 -> (root dir,
+    in-memory trained params)."""
+    root = tmp_path_factory.mktemp("train_ckpt")
+    opt = optax.adam(1e-2)
+    params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+    state = {
+        "params": params,
+        "opt_state": opt.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step_fn = make_lm_train_step(tfm.forward, CFG, opt, donate=False)
+    rng = np.random.default_rng(0)
+    batches = [
+        jnp.asarray(rng.integers(1, CFG.vocab_size, (2, 17)))
+        for _ in range(6)
+    ]
+    mgr = CheckpointManager(str(root), save_interval=3, max_to_keep=2)
+    state, loss = train_loop(step_fn, state, batches, checkpoint_manager=mgr)
+    assert float(loss) == float(loss)  # trained without NaNs
+    return str(root), state["params"]
+
+
+def engine_generate(params, prompts, n=6, **engine_kwargs):
+    engine = InferenceEngine(params, CFG, max_slots=2, max_len=48, **engine_kwargs)
+    return _drive(engine, prompts, n)
+
+
+def _drive(engine, prompts, n):
+    engine.start()
+    try:
+        handles = [engine.submit(p, n) for p in prompts]
+        return [h.result(timeout=120) for h in handles]
+    finally:
+        engine.stop()
+
+
+def test_restored_params_serve_identically(trained):
+    """The flagship story in one test: train with the framework,
+    checkpoint, restore into the engine — generation must equal serving
+    the in-memory trained params."""
+    root, live_params = trained
+    params, step = load_serving_params(root, CFG)
+    assert step == 6, "latest step dir must win"
+    assert not isinstance(params, dict) or "opt_state" not in params
+    assert engine_generate(params, PROMPTS) == engine_generate(
+        live_params, PROMPTS
+    )
+
+
+def test_restore_selects_step_and_direct_dir(trained):
+    root, _ = trained
+    p3, s3 = load_serving_params(root, CFG, step=3)
+    p6, s6 = load_serving_params(root, CFG, step=6)
+    assert (s3, s6) == (3, 6)
+    # training moved the weights between the two checkpoints
+    assert not np.allclose(
+        np.asarray(p3["embed"], np.float32),
+        np.asarray(p6["embed"], np.float32),
+    )
+    import os
+
+    direct, sd = load_serving_params(
+        os.path.join(root, "step_00000003"), CFG
+    )
+    assert sd == 3
+    assert np.array_equal(
+        np.asarray(direct["embed"], np.float32),
+        np.asarray(p3["embed"], np.float32),
+    )
+    with pytest.raises(FileNotFoundError):
+        load_serving_params(root, CFG, step=99)
+
+
+def test_tp_elastic_restore_serves_identically(trained):
+    """A checkpoint saved from single-device training restores DIRECTLY
+    sharded onto a 2-way tensor-parallel serving mesh (no host bounce)
+    and the TP engine generates the same tokens."""
+    from jax.sharding import PartitionSpec as P
+
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    root, live_params = trained
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    params, _ = load_serving_params(root, CFG, mesh=mesh)
+    wq = params["layers"][0]["wq"]
+    assert wq.sharding.spec == P(None, "model"), "restore must land sharded"
+    got = _drive(
+        InferenceEngine(params, CFG, max_slots=2, max_len=48, mesh=mesh),
+        PROMPTS,
+        6,
+    )
+    assert got == engine_generate(live_params, PROMPTS)
+
+
+def test_from_checkpoint_int8_and_self_draft(trained):
+    """``from_checkpoint`` composes the seam with the engine features:
+    int8 weight quantization matches quantizing the live params exactly,
+    and a restored draft (self-draft here) stays lossless."""
+    from devspace_tpu.inference.quantization import quantize_params
+
+    root, live_params = trained
+    engine = InferenceEngine.from_checkpoint(
+        root, CFG, quantize="int8", max_slots=2, max_len=48
+    )
+    got_q = _drive(engine, PROMPTS, 6)
+    assert got_q == engine_generate(quantize_params(live_params), PROMPTS)
+
+    spec_engine = InferenceEngine.from_checkpoint(
+        root, CFG, draft_checkpoint=root, draft_cfg=CFG,
+        max_slots=2, max_len=48,
+    )
+    got_spec = _drive(spec_engine, PROMPTS, 6)
+    assert spec_engine.spec_rounds > 0, "speculative path must have run"
+    assert got_spec == engine_generate(live_params, PROMPTS)
+    with pytest.raises(ValueError, match="draft_cfg without"):
+        InferenceEngine.from_checkpoint(root, CFG, draft_cfg=CFG)
+
+
+def test_bare_params_checkpoint_loads(trained, tmp_path):
+    root, live_params = trained
+    path = str(tmp_path / "bare")
+    save_checkpoint(path, live_params)
+    params, step = load_serving_params(path, CFG)
+    assert step is None
+    assert engine_generate(params, PROMPTS[:1]) == engine_generate(
+        live_params, PROMPTS[:1]
+    )
+
+
+def test_wrong_config_fails_clearly(trained):
+    root, _ = trained
+    wrong = dataclasses.replace(CFG, dim=CFG.dim * 2)
+    with pytest.raises(ValueError, match="does not match the serving config"):
+        load_serving_params(root, wrong)
+    with pytest.raises(FileNotFoundError):
+        load_serving_params(root + "_nonexistent", CFG)
