@@ -56,6 +56,51 @@ def synthetic_tokens(
         )
 
 
+def markov_sampler(active: int = 256, noise: float = 0.02, seed: int = 0):
+    """LEARNABLE synthetic LM corpus: an order-2 deterministic transition
+    table over tokens ``1..active-1`` with ``noise`` resample probability.
+    Unlike ``synthetic_tokens`` (uniform — nothing to learn), next-token
+    entropy here is near zero but needs TWO tokens of context, so model
+    quality — and draft/target greedy agreement in speculative decoding —
+    reflects what a model actually learned, not unigram stats.
+
+    Returns ``sample(n, length, seed)`` -> ``np.ndarray [n, length]``;
+    the table is a pure function of ``(active, seed)``, so training,
+    serving benches and tests reproduce the same corpus from the config
+    alone."""
+    table = np.random.default_rng(seed).integers(
+        1, active, size=(active, active)
+    )
+
+    def sample(n: int, length: int, seed: int = 1) -> np.ndarray:
+        g = np.random.default_rng(seed)
+        seq = np.empty((n, length), np.int64)
+        seq[:, :2] = g.integers(1, active, size=(n, 2))
+        for t in range(2, length):
+            nxt = table[seq[:, t - 2], seq[:, t - 1]]
+            flip = g.random(n) < noise
+            seq[:, t] = np.where(flip, g.integers(1, active, size=n), nxt)
+        return seq
+
+    return sample
+
+
+def markov_tokens(
+    batch_size: int,
+    seq_len: int,
+    active: int = 256,
+    noise: float = 0.02,
+    seed: int = 0,
+) -> Iterator[jax.Array]:
+    """``markov_sampler`` behind the train-loop iterator contract (a
+    fresh batch per step, deterministic in ``seed``)."""
+    sample = markov_sampler(active=active, noise=noise, seed=seed)
+    step = 0
+    while True:
+        step += 1
+        yield jnp.asarray(sample(batch_size, seq_len, seed=seed + step), jnp.int32)
+
+
 def prefetch_to_device(
     iterator: Iterator,
     size: int = 2,

@@ -20,6 +20,8 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     # (same workaround as tests/conftest.py and bench.py)
     jax.config.update("jax_platforms", "cpu")
 
+import json
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,6 +42,12 @@ NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", 64))
 # BENCH_KV_DTYPE=int8 stores the paged pool quantized (halved KV HBM:
 # the pressure phase fits ~2x the blocks in the same budget)
 KV_DTYPE = os.environ.get("BENCH_KV_DTYPE") or None
+# BENCH_DRAFT_DIR=<scripts/train_draft_pair.py --out>: serve the TRAINED
+# target and measure the spec phase with its TRAINED draft (restored
+# through the train->serve seam) on corpus-distributed prompts — the
+# real draft economics, not the self-draft mechanism ceiling. The pair's
+# saved configs override BENCH_DIM/BENCH_LAYERS/BENCH_FFN.
+DRAFT_DIR = os.environ.get("BENCH_DRAFT_DIR")
 # which phases to run (comma list); smoke runs can pick one
 PHASES = set(
     os.environ.get(
@@ -114,14 +122,48 @@ def _stream_arrivals(handle, timeout: float, on_token=None) -> list:
 
 
 def main():
-    params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+    global CFG
+    rng = np.random.default_rng(0)
+    draft_params = draft_cfg = pair_meta = None
+    if DRAFT_DIR:
+        from devspace_tpu.inference import load_serving_params
+        from devspace_tpu.training.data import markov_sampler
+
+        with open(os.path.join(DRAFT_DIR, "pair.json")) as f:
+            pair_meta = json.load(f)
+        CFG = tfm.TransformerConfig(**pair_meta["target"])
+        draft_cfg = tfm.TransformerConfig(**pair_meta["draft"])
+        params, _ = load_serving_params(os.path.join(DRAFT_DIR, "target"), CFG)
+        if "spec" in PHASES:  # no other phase reads the draft; skip the
+            draft_params, _ = load_serving_params(  # slow tunnel transfer
+                os.path.join(DRAFT_DIR, "draft"), draft_cfg
+            )
+        sample = markov_sampler(**pair_meta["corpus"])
+        # corpus-distributed prompts: acceptance is only meaningful on
+        # inputs shaped like what the pair was trained on
+        prompts = [
+            list(sample(1, int(rng.integers(4, 32)), seed=1000 + i)[0])
+            for i in range(N_REQ)
+        ]
+        print(
+            f"[inf-bench] trained pair from {DRAFT_DIR}: "
+            f"target {CFG.dim}x{CFG.n_layers}, draft "
+            f"{draft_cfg.dim}x{draft_cfg.n_layers} "
+            f"({pair_meta['params_ratio']}x params), held-out greedy "
+            f"agreement {pair_meta['target_draft_agreement']}",
+            file=sys.stderr,
+        )
+    else:
+        params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+        prompts = [
+            list(rng.integers(1, 1000, size=rng.integers(4, 32)))
+            for _ in range(N_REQ)
+        ]
     if os.environ.get("BENCH_QUANT") == "1":
         from devspace_tpu.inference.quantization import quantize_params
 
         params = quantize_params(params)
         print("[inf-bench] serving int8 weight-only quantized params", file=sys.stderr)
-    rng = np.random.default_rng(0)
-    prompts = [list(rng.integers(1, 1000, size=rng.integers(4, 32))) for _ in range(N_REQ)]
     total_new = N_REQ * NEW_TOKENS
 
     # serial: one generate per request (compile once on a warmup)
@@ -193,6 +235,7 @@ def main():
     # high acceptance into a net speedup.
     spec = None
     if "spec" in PHASES:
+        trained = draft_params is not None
         spec_s, st = timed_wave(
             InferenceEngine(
                 params,
@@ -200,8 +243,8 @@ def main():
                 max_slots=N_REQ,
                 max_len=256,
                 chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
-                draft_params=params,
-                draft_cfg=CFG,
+                draft_params=draft_params if trained else params,
+                draft_cfg=draft_cfg if trained else CFG,
                 spec_k=int(os.environ.get("BENCH_SPEC_K", 4)),
                 kv_dtype=KV_DTYPE,
             ).start()
@@ -221,8 +264,16 @@ def main():
             )
             if st["spec_rounds"]
             else 0.0,
-            "note": "self-draft (target weights): acceptance ceiling + "
-            "verify economics, not a trained-small-draft speedup",
+            "draft": "trained" if trained else "self",
+            "note": (
+                f"TRAINED draft ({draft_cfg.dim}x{draft_cfg.n_layers}, "
+                f"{pair_meta['params_ratio']}x fewer params, held-out "
+                f"greedy agreement {pair_meta['target_draft_agreement']}) "
+                f"restored via the train->serve seam; corpus prompts"
+                if trained
+                else "self-draft (target weights): acceptance ceiling + "
+                "verify economics, not a trained-small-draft speedup"
+            ),
         }
         vs = (
             f" ({spec['vs_plain_engine']}x plain engine)"
@@ -230,7 +281,8 @@ def main():
             else ""
         )
         print(
-            f"[inf-bench] speculative (self-draft, k={spec['spec_k']}): "
+            f"[inf-bench] speculative ({spec['draft']}-draft, "
+            f"k={spec['spec_k']}): "
             f"{spec['tok_per_sec']} tok/s{vs}, acceptance "
             f"{spec['acceptance']}, {spec['committed_per_round_all_slots']} "
             f"tok/round (all slots)",
@@ -299,8 +351,6 @@ def main():
     if "pressure" in PHASES:
         pressure = _pressure_phase(params, rng)
 
-    import json
-
     from devspace_tpu.ops.dispatch import use_pallas
 
     result = {
@@ -330,6 +380,20 @@ def main():
             "paged_kv_block": 64,
             "kv_dtype": KV_DTYPE or "bf16/f32 (model dtype)",
             "chunk_max": int(os.environ.get("BENCH_CHUNK", 8)),
+            "trained_pair": (
+                {
+                    "dir": DRAFT_DIR,
+                    "draft_dim": draft_cfg.dim,
+                    "draft_layers": draft_cfg.n_layers,
+                    "params_ratio": pair_meta["params_ratio"],
+                    "held_out_greedy_agreement": pair_meta[
+                        "target_draft_agreement"
+                    ],
+                    "corpus": pair_meta["corpus"],
+                }
+                if pair_meta
+                else None
+            ),
         },
     }
     print(json.dumps(result))

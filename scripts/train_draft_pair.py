@@ -1,0 +1,223 @@
+"""Train a target + much-smaller draft LM on the same learnable corpus.
+
+The input artifact for the trained-draft speculative serving bench
+(VERDICT r4 next #3): speculative decoding's economics need a draft that
+GENUINELY predicts the target — random weights measure only the
+mechanism's ceiling. Both models train on the order-2 Markov corpus
+(training/data.py:markov_sampler), checkpoint under ``--out``
+(``target/`` and ``draft/`` step roots + ``pair.json`` with the configs,
+corpus parameters and measured greedy agreement), and the serving bench
+(scripts/bench_inference.py, ``BENCH_DRAFT_DIR``) restores them through
+the train->serve seam (inference/checkpoint.py).
+
+Usage::
+
+    python scripts/train_draft_pair.py --out runs/spec_pair [--steps 600]
+
+Target size follows the bench envs (BENCH_DIM/BENCH_LAYERS/BENCH_FFN);
+draft size follows DRAFT_DIM/DRAFT_LAYERS/DRAFT_FFN/DRAFT_HEADS.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the image's sitecustomize pre-imports jax and freezes the platform
+    # default at interpreter startup (same workaround as bench_inference)
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from devspace_tpu.models import transformer as tfm
+from devspace_tpu.training.checkpoint import CheckpointManager
+from devspace_tpu.training.data import markov_sampler
+from devspace_tpu.training.trainer import make_lm_train_step, train_loop
+
+
+def bench_target_cfg() -> tfm.TransformerConfig:
+    """Same env knobs as scripts/bench_inference.py so the pair slots
+    straight into the serving bench."""
+    return tfm.TransformerConfig(
+        vocab_size=32_000,
+        dim=int(os.environ.get("BENCH_DIM", 1024)),
+        n_layers=int(os.environ.get("BENCH_LAYERS", 8)),
+        n_heads=8,
+        n_kv_heads=8,
+        ffn_dim=int(os.environ.get("BENCH_FFN", 2816)),
+        max_seq_len=1024,
+    )
+
+
+def bench_draft_cfg(target: tfm.TransformerConfig) -> tfm.TransformerConfig:
+    """~8x fewer non-embedding FLOPs than the default target (dim/4,
+    layers/4): small enough that a draft step is cheap next to a verify,
+    big enough to actually learn the corpus."""
+    return tfm.TransformerConfig(
+        vocab_size=target.vocab_size,
+        dim=int(os.environ.get("DRAFT_DIM", 256)),
+        n_layers=int(os.environ.get("DRAFT_LAYERS", 2)),
+        n_heads=int(os.environ.get("DRAFT_HEADS", 4)),
+        n_kv_heads=int(os.environ.get("DRAFT_HEADS", 4)),
+        ffn_dim=int(os.environ.get("DRAFT_FFN", 704)),
+        max_seq_len=target.max_seq_len,
+    )
+
+
+def _param_count(params) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+def _cfg_dict(cfg: tfm.TransformerConfig) -> dict:
+    d = dataclasses.asdict(cfg)
+    d.pop("dtype", None)  # jnp dtype isn't JSON; pair configs use the default
+    return d
+
+
+def train_one(
+    name: str,
+    cfg: tfm.TransformerConfig,
+    root: str,
+    sample,
+    steps: int,
+    batch: int,
+    seq: int,
+    lr: float,
+    seed: int,
+    log=print,
+) -> dict:
+    """Train ``cfg`` on the corpus for ``steps``, checkpoint the final
+    state under ``root``, return the trained params."""
+    opt = optax.adam(lr)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+    state = {
+        "params": params,
+        "opt_state": opt.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step_fn = make_lm_train_step(tfm.forward, cfg, opt, donate=False)
+    batches = (
+        jnp.asarray(sample(batch, seq, seed=seed * 100_000 + s), jnp.int32)
+        for s in range(steps)
+    )
+    t0 = time.time()
+    state, loss = train_loop(step_fn, state, batches)
+    # serving artifact: the bare params tree (the seam loader accepts
+    # both layouts). Saving the full train state would move the Adam
+    # moments too — 3x the bytes through a slow tunnel for nothing the
+    # serving bench reads.
+    mgr = CheckpointManager(str(root), save_interval=steps, max_to_keep=1)
+    mgr.save(steps, state["params"])
+    log(
+        f"[pair] {name}: {steps} steps in {time.time() - t0:.1f}s, "
+        f"final loss {float(loss):.4f}, "
+        f"{_param_count(state['params']) / 1e6:.1f}M params"
+    )
+    return state["params"]
+
+
+def greedy_agreement(
+    t_params, t_cfg, d_params, d_cfg, sample, n=64, length=65, seed=9
+) -> dict:
+    """Held-out greedy next-token agreement between target and draft (the
+    static proxy for speculative acceptance) + each model's accuracy
+    against the corpus. Positions with full order-2 context only."""
+    tokens = jnp.asarray(sample(n, length, seed=seed), jnp.int32)
+
+    def preds(params, cfg):
+        logits = jax.jit(
+            lambda p, t: jnp.argmax(tfm.forward(p, t, cfg), axis=-1)
+        )(params, tokens[:, :-1])
+        return np.asarray(logits)
+
+    tp, dp = preds(t_params, t_cfg), preds(d_params, d_cfg)
+    actual = np.asarray(tokens[:, 1:])
+    sl = slice(1, None)  # pred i needs tokens i-1, i of context
+    return {
+        "target_draft_agreement": round(float((tp[:, sl] == dp[:, sl]).mean()), 4),
+        "target_accuracy": round(float((tp[:, sl] == actual[:, sl]).mean()), 4),
+        "draft_accuracy": round(float((dp[:, sl] == actual[:, sl]).mean()), 4),
+    }
+
+
+def train_pair(
+    out: str,
+    target_cfg: tfm.TransformerConfig,
+    draft_cfg: tfm.TransformerConfig,
+    corpus: dict,
+    steps: int,
+    batch: int = 32,
+    seq: int = 129,
+    lr: float = 3e-4,
+    log=print,
+) -> dict:
+    """Full pipeline: train both models, measure agreement, write
+    ``pair.json``. Returns the pair metadata dict."""
+    if corpus["active"] > target_cfg.vocab_size:  # tokens are 1..active-1
+        raise ValueError("corpus active symbols must fit the vocab")
+    sample = markov_sampler(**corpus)
+    t_params = train_one(
+        "target", target_cfg, os.path.join(out, "target"),
+        sample, steps, batch, seq, lr, seed=0, log=log,
+    )
+    d_params = train_one(
+        "draft", draft_cfg, os.path.join(out, "draft"),
+        sample, steps, batch, seq, lr, seed=1, log=log,
+    )
+    metrics = greedy_agreement(
+        t_params, target_cfg, d_params, draft_cfg, sample
+    )
+    meta = {
+        "target": _cfg_dict(target_cfg),
+        "draft": _cfg_dict(draft_cfg),
+        "corpus": corpus,
+        "steps": steps,
+        "batch": batch,
+        "seq": seq,
+        "lr": lr,
+        "params_ratio": round(_param_count(t_params) / _param_count(d_params), 2),
+        **metrics,
+    }
+    with open(os.path.join(out, "pair.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    log(f"[pair] {json.dumps(metrics)} (params ratio {meta['params_ratio']}x)")
+    return meta
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=129)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--active", type=int, default=512)
+    ap.add_argument("--noise", type=float, default=0.02)
+    ap.add_argument("--corpus-seed", type=int, default=0)
+    args = ap.parse_args()
+    target = bench_target_cfg()
+    draft = bench_draft_cfg(target)
+    meta = train_pair(
+        args.out,
+        target,
+        draft,
+        {"active": args.active, "noise": args.noise, "seed": args.corpus_seed},
+        steps=args.steps,
+        batch=args.batch,
+        seq=args.seq,
+        lr=args.lr,
+    )
+    print(json.dumps(meta))
+
+
+if __name__ == "__main__":
+    main()
