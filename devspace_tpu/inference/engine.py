@@ -203,6 +203,7 @@ class InferenceEngine:
         spec_k: int = 4,
         kv_dtype: Optional[str] = None,
         prefix_cache: bool = True,
+        prewarm: bool = False,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
@@ -243,7 +244,10 @@ class InferenceEngine:
         dry. LOSSLESS: cached K/V is exactly what recomputation would
         produce (same tokens, same chunking, causal), and a shared
         block is never written again — decode/prefill writes land only
-        in private blocks past the matched prefix."""
+        in private blocks past the matched prefix.
+
+        ``prewarm=True`` compiles every reachable program in ``start()``
+        before the scheduler thread runs (see :meth:`prewarm`)."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -393,6 +397,7 @@ class InferenceEngine:
         # are the literal token tuples — no hash-collision risk, host
         # memory is a few KB per cached block at serving scale.
         self.prefix_cache_enabled = bool(prefix_cache)
+        self._prewarm_on_start = bool(prewarm)
         self._prefix_map: "OrderedDict[tuple, int]" = OrderedDict()
         self._published: dict[int, tuple] = {}  # blk -> its key
         self._block_refs: dict[int, int] = {}  # blk -> table references
@@ -668,10 +673,93 @@ class InferenceEngine:
         return req
 
     def start(self) -> "InferenceEngine":
+        if self._prewarm_on_start:
+            self.prewarm()
         self._started_at = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
+
+    def prewarm(self) -> dict:
+        """Compile every program serving can reach, BEFORE traffic does.
+
+        Without this, compilation is lazy per shape bucket, and a
+        prefix-cache hit can shift a prompt's tail into a prefill bucket
+        no cold-path request ever compiled — paying a multi-second XLA
+        compile mid-serving (docs/PERF.md measured 19.5s at 1.3B). The
+        chunking only ever emits bucket shapes (power-of-two final
+        chunks + the full ``prefill_chunk``; ``_prefill_one_chunk``
+        shrinks by whole buckets at the table edge), so compiling the
+        bucket set here is a complete no-new-compiles guarantee —
+        pinned by tests/test_inference.py with a jit-cache-size probe.
+
+        Every dispatch uses all-zero block tables, so writes land in the
+        reserved scratch block 0 and pool contents are untouched (the
+        same parked-slot convention the scheduler itself relies on).
+        Returns ``{program_name: compile_seconds}``."""
+        if self._thread is not None and self._thread.is_alive():
+            # the scheduler thread owns the pool once it runs; racing it
+            # with donated-pool dispatches would corrupt serving state
+            raise RuntimeError("prewarm() must run before start()")
+        timings: dict[str, float] = {}
+        B = self.max_slots
+        zero_tables = jnp.zeros((B, self.max_blocks), jnp.int32)
+        zb = jnp.zeros((B,), jnp.int32)
+        for c in self._pow2_buckets(self.prefill_chunk):
+            t0 = time.monotonic()
+            _, self.pool = self._prefill_step_jit(
+                self.params,
+                self.pool,
+                zero_tables[0],
+                jnp.zeros((c,), jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            )
+            timings[f"prefill_{c}"] = round(time.monotonic() - t0, 3)
+        for (k, filt), fn in self._decode_chunk.items():
+            t0 = time.monotonic()
+            self.pool, self._keys, _ = fn(
+                self.params,
+                self.pool,
+                zero_tables,
+                zb,
+                zb,
+                jnp.zeros((B,), jnp.float32),
+                zb,
+                jnp.ones((B,), jnp.float32),
+                self._keys,
+                self._eos_ids,
+                self._min_until,
+                self._logit_bias,
+            )
+            timings[f"decode_{k}{'_filters' if filt else ''}"] = round(
+                time.monotonic() - t0, 3
+            )
+        if self.draft_params is not None:
+            # _draft_prefill buckets: powers of two, clamped at max_len
+            # (itself a bucket when not a power of two)
+            for c in self._pow2_buckets(self.max_len):
+                t0 = time.monotonic()
+                self._draft_cache = self._draft_prefill_jit(
+                    self.draft_params,
+                    self._draft_cache,
+                    jnp.zeros((c,), jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                )
+                timings[f"draft_prefill_{c}"] = round(time.monotonic() - t0, 3)
+            t0 = time.monotonic()
+            self.pool, self._draft_cache, _, _ = self._spec_round_jit(
+                self.params,
+                self.draft_params,
+                self.pool,
+                self._draft_cache,
+                zero_tables,
+                zb,
+                jnp.full((B,), self.max_len, jnp.int32),  # parked draft pos
+                zb,
+            )
+            timings["spec_round"] = round(time.monotonic() - t0, 3)
+        jax.block_until_ready(self.pool)
+        return timings
 
     def stats(self) -> dict:
         """Serving counters: completed/failed requests, tokens generated,
@@ -905,6 +993,19 @@ class InferenceEngine:
             b *= 2
         return min(b, self.prefill_chunk)
 
+    @staticmethod
+    def _pow2_buckets(limit: int, include_limit: bool = True) -> list[int]:
+        """Power-of-two sizes up to ``limit`` (plus ``limit`` itself when
+        ``include_limit`` and it is not one) — THE bucket enumeration the
+        shape-keyed dispatch paths and prewarm() share; the
+        no-new-compiles guarantee holds only while they agree."""
+        out = [1]
+        while out[-1] * 2 <= limit:
+            out.append(out[-1] * 2)
+        if include_limit and out[-1] != limit:
+            out.append(limit)
+        return out
+
     def _chunk_sizes(self) -> list[int]:
         sizes = [1]
         while sizes[-1] * 2 <= self.chunk_max:
@@ -1012,9 +1113,13 @@ class InferenceEngine:
         )
         # the chunk's positions offset..offset+c-1 must stay inside the
         # slot's table span — an overshooting pad tail would clamp into
-        # the prompt's last allocated block and corrupt its K/V
+        # the prompt's last allocated block and corrupt its K/V. Shrink
+        # by whole buckets, not to the raw span: an arbitrary-length
+        # chunk would be a shape no one compiled (prewarm() enumerates
+        # the bucket set and promises no mid-serving compiles).
         t_alloc = self.max_blocks * self.block_size
-        c = min(c, t_alloc - offset)
+        if c > t_alloc - offset:
+            c = self._pow2_buckets(t_alloc - offset, include_limit=False)[-1]
         real = min(remaining, c)
         chunk = slot.prompt[offset : offset + real] + [0] * (c - real)
         table = jnp.asarray(self._tables[slot_idx])

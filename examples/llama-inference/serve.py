@@ -8,10 +8,17 @@ continuously batched by devspace_tpu.inference.InferenceEngine
 (iteration-level scheduling — a long generation never blocks a short one).
 Defaults to the TINY config so it runs anywhere; set MODEL=llama2-7b on a
 real TPU pod with weights mounted.
+
+Env knobs: CHECKPOINT=<dir> restores trained weights through the
+train->serve seam (DRAFT_CHECKPOINT for the draft); QUANTIZE=int8 serves
+weight-only-quantized; PREWARM=1 compiles every serving program before
+the port opens (no mid-serving XLA compiles); MAX_SLOTS / CHUNK_MAX /
+SPEC / SPEC_K / DRAFT_MODEL / PORT as below.
 """
 
 import json
 import os
+import time
 
 import jax
 
@@ -109,7 +116,17 @@ class Server:
             draft_params=draft_params,
             draft_cfg=draft_cfg,
             spec_k=self.spec_k,
-        ).start()
+        )
+        # PREWARM=1 compiles every prefill bucket / decode chunk / spec
+        # program before the port opens — no mid-serving XLA compiles
+        # (a prefix-cache-shifted tail otherwise pays one; docs/PERF.md)
+        if os.environ.get("PREWARM", "0") == "1":
+            t0 = time.time()
+            timings = self.engine.prewarm()
+            print(
+                f"prewarmed {len(timings)} programs in {time.time() - t0:.1f}s"
+            )
+        self.engine.start()
 
     def generate_speculative(self, prompt_ids, max_new_tokens, k=None):
         """Greedy generation through the ENGINE's speculative path
