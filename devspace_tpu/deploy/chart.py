@@ -129,6 +129,7 @@ def render_chart(
     if values:
         merged_values = merge(merged_values, values)
     _derive_persistence(merged_values)
+    _derive_autoscaling(merged_values)
     context = {
         "values": merged_values,
         "release": {"name": release_name, "namespace": namespace},
@@ -177,6 +178,7 @@ def render_chart(
             # shape differs
             if not is_helm_chart(pkg_dir):
                 _derive_persistence(sub_values)
+                _derive_autoscaling(sub_values)
             pkg_context = {
                 **context,
                 "values": sub_values,
@@ -250,6 +252,104 @@ def _derive_persistence(values: dict) -> None:
         ],
     )
     pers.setdefault("mounts", [])
+
+
+def _derive_autoscaling(values: dict) -> None:
+    """Engine convention for horizontal pod autoscaling — the reference's
+    ``autoScaling.horizontal`` values gate
+    (/root/reference/examples/php-mysql-example/chart/templates/
+    pod-autoscaling.yaml: rendered only when ``maxReplicas`` exceeds the
+    component's ``replicas``), expressed as a derived list the charts'
+    hpa.yaml consumes via x-devspace-for-each (empty -> no HPA rendered):
+
+    .. code-block:: yaml
+
+        autoscaling:
+          horizontal:
+            maxReplicas: 5      # must exceed replicas to render
+            averageCPU: 80      # % target utilization
+            averageMemory: 512Mi  # absolute target (optional)
+
+    Emits autoscaling/v2 ``metrics`` entries (the reference's v2beta1
+    fields upgraded to the ``target:`` schema current clusters accept).
+    An explicitly-set ``autoscaling.objects`` wins (only filled when
+    absent), like the persistence derivations above."""
+    auto = values.get("autoscaling")
+    if not isinstance(auto, dict):
+        return
+    hor = auto.get("horizontal")
+    if not isinstance(hor, dict) or not hor:
+        auto.setdefault("objects", [])
+        return
+    try:
+        replicas = int(values.get("replicas") or 1)
+    except (TypeError, ValueError):
+        replicas = 1
+    if hor.get("maxReplicas") is None:
+        raise ChartError(
+            "autoscaling.horizontal needs maxReplicas (metrics alone "
+            "render nothing; the gate would silently drop the HPA)"
+        )
+    try:
+        max_replicas = int(hor["maxReplicas"])
+    except (TypeError, ValueError) as e:
+        raise ChartError(
+            f"autoscaling.horizontal.maxReplicas must be an integer: {e}"
+        ) from e
+    if max_replicas <= replicas:
+        # the reference's gt-gate: an HPA capped at or below the static
+        # replica count could only fight the Deployment
+        auto.setdefault("objects", [])
+        return
+    metrics = []
+    if hor.get("averageCPU") is not None:
+        try:
+            cpu = int(hor["averageCPU"])
+        except (TypeError, ValueError) as e:
+            raise ChartError(
+                f"autoscaling.horizontal.averageCPU must be an integer "
+                f"percentage: {e}"
+            ) from e
+        metrics.append(
+            {
+                "type": "Resource",
+                "resource": {
+                    "name": "cpu",
+                    "target": {
+                        "type": "Utilization",
+                        "averageUtilization": cpu,
+                    },
+                },
+            }
+        )
+    if hor.get("averageMemory"):
+        metrics.append(
+            {
+                "type": "Resource",
+                "resource": {
+                    "name": "memory",
+                    "target": {
+                        "type": "AverageValue",
+                        "averageValue": str(hor["averageMemory"]),
+                    },
+                },
+            }
+        )
+    if not metrics:
+        raise ChartError(
+            "autoscaling.horizontal needs averageCPU and/or averageMemory "
+            "(an HPA without metrics cannot scale)"
+        )
+    auto.setdefault(
+        "objects",
+        [
+            {
+                "minReplicas": replicas,
+                "maxReplicas": max_replicas,
+                "metrics": metrics,
+            }
+        ],
+    )
 
 
 # Doc-level expansion directive: a template document carrying this key is

@@ -167,6 +167,46 @@ def validate_manifests(docs: list[dict]) -> list[str]:
                             f"undeclared volume {mname!r} (pod volumes/"
                             f"claimTemplates: {sorted(declared) or 'none'})"
                         )
+        if kind == "HorizontalPodAutoscaler":
+            spec = doc.get("spec") or {}
+            ref = spec.get("scaleTargetRef") or {}
+            if not ref.get("kind") or not ref.get("name"):
+                issues.append(
+                    f"{label}: scaleTargetRef needs kind+name ({ref!r})"
+                )
+            else:
+                resolved = any(
+                    isinstance(d, dict)
+                    and d.get("kind") == ref["kind"]
+                    and (d.get("metadata") or {}).get("name") == ref["name"]
+                    for d in docs
+                )
+                if not resolved:
+                    issues.append(
+                        f"{label}: scaleTargetRef {ref['kind']}/"
+                        f"{ref['name']} is not among the rendered objects"
+                    )
+            max_r = spec.get("maxReplicas")
+            min_r = spec.get("minReplicas", 1)
+            if not isinstance(max_r, int) or max_r < 1:
+                issues.append(
+                    f"{label}: maxReplicas must be a positive integer "
+                    f"({max_r!r})"
+                )
+            elif isinstance(min_r, int) and min_r > max_r:
+                issues.append(
+                    f"{label}: minReplicas {min_r} > maxReplicas {max_r}"
+                )
+            if not isinstance(min_r, int):
+                issues.append(
+                    f"{label}: minReplicas must be an integer ({min_r!r})"
+                )
+            elif min_r < 1:
+                issues.append(f"{label}: minReplicas must be >= 1 ({min_r})")
+            if not spec.get("metrics"):
+                issues.append(
+                    f"{label}: no metrics — the HPA could never scale"
+                )
         if kind == "StatefulSet":
             svc = (doc.get("spec") or {}).get("serviceName")
             if not svc:
@@ -212,6 +252,7 @@ def lint_tpu_consistency(
                 f"workers x chipsPerWorker = {workers * chips_per_worker}"
             )
     slice_workloads = 0
+    slice_ids: set[tuple[str, str]] = set()
     for doc in docs:
         if not isinstance(doc, dict) or doc.get("kind") not in _WORKLOAD_KINDS:
             continue
@@ -233,6 +274,9 @@ def lint_tpu_consistency(
         if not is_slice:
             continue
         slice_workloads += 1
+        slice_ids.add(
+            (str(doc.get("kind")), str((doc.get("metadata") or {}).get("name")))
+        )
         label = f"{doc.get('kind')}/{(doc.get('metadata') or {}).get('name')}"
         replicas = (doc.get("spec") or {}).get("replicas")
         if replicas is not None:
@@ -283,6 +327,28 @@ def lint_tpu_consistency(
             "tpu: config has a tpu block but no rendered workload requests "
             "google.com/tpu or wires TPU_WORKER_ID/TPU_WORKER_HOSTNAMES"
         )
+    # Slice atomicity vs autoscaling: a MULTI-host slice's worker count
+    # is topology (every ordinal must exist — TPU_WORKER_HOSTNAMES is a
+    # static roster), so an HPA must never resize it. Single-host slice
+    # workloads (workers == 1) may scale: each replica is an independent
+    # model server on its own TPU host (the serving story).
+    if workers > 1:
+        for doc in docs:
+            if (
+                not isinstance(doc, dict)
+                or doc.get("kind") != "HorizontalPodAutoscaler"
+            ):
+                continue
+            ref = ((doc.get("spec") or {}).get("scaleTargetRef")) or {}
+            if (str(ref.get("kind")), str(ref.get("name"))) in slice_ids:
+                issues.append(
+                    f"HorizontalPodAutoscaler/"
+                    f"{(doc.get('metadata') or {}).get('name')}: targets "
+                    f"multi-host slice workload {ref.get('kind')}/"
+                    f"{ref.get('name')} ({workers} workers) — slice worker "
+                    f"count is topology, not load; HPAs fit single-host "
+                    f"serving replicas only"
+                )
     return issues
 
 

@@ -245,3 +245,50 @@ def test_redeploy_example_uses_watch_only_loop(tmp_path, monkeypatch):
         loop.stop()
         loop.stop_services()
         t.join(timeout=5)
+
+
+def test_kaniko_example_autoscaling_renders_lints_and_fake_deploys(tmp_path):
+    """HPA parity end-to-end (the reference's kaniko example ships the
+    same gated pod-autoscaling template): the example's enabled
+    autoscaling values render an autoscaling/v2 HPA bound to the
+    Deployment, pass lint (incl. the HPA checks), and apply on the fake
+    cluster with everything else."""
+    from devspace_tpu.config import latest
+    from devspace_tpu.config.generated import CacheConfig
+    from devspace_tpu.deploy.chart import ChartDeployer
+    from devspace_tpu.deploy.lint import validate_manifests
+    from devspace_tpu.kube.fake import FakeCluster
+
+    example = next(e for e in EXAMPLES if e.endswith("kaniko"))
+    manifests = render_chart(
+        os.path.join(example, "chart"),
+        release_name="kaniko-app",
+        namespace="default",
+        values={"image": "registry.local/x:y"},
+        extra_context={"images": {}, "pullSecrets": [], "tpu": {}},
+    )
+    hpa = next(
+        m for m in manifests if m["kind"] == "HorizontalPodAutoscaler"
+    )
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    assert hpa["spec"]["scaleTargetRef"]["name"] == "kaniko-app"
+    assert hpa["spec"]["minReplicas"] == 1
+    assert hpa["spec"]["maxReplicas"] == 4
+    assert {m["resource"]["name"] for m in hpa["spec"]["metrics"]} == {
+        "cpu",
+        "memory",
+    }
+    assert validate_manifests(manifests) == []
+
+    fc = FakeCluster(str(tmp_path))
+    d = latest.DeploymentConfig(
+        name="kaniko-app",
+        chart=latest.ChartConfig(
+            path=os.path.join(example, "chart"),
+            values={"image": "registry.local/x:y"},
+        ),
+    )
+    assert ChartDeployer(fc, d, "default").deploy(cache=CacheConfig()) is True
+    assert fc.get_object(
+        "autoscaling/v2", "HorizontalPodAutoscaler", "kaniko-app", "default"
+    )

@@ -492,3 +492,208 @@ def test_semver_caret_zero_precision():
     assert _semver_compare("^0.0.3", "0.0.4") is False
     assert _semver_compare("^0.2.3", "0.2.9") is True
     assert _semver_compare("^0.2.3", "0.3.0") is False
+
+
+def test_autoscaling_derivation_renders_hpa_and_lints():
+    """HPA parity (reference examples' pod-autoscaling.yaml): the
+    generator chart's autoscaling values render an autoscaling/v2 HPA,
+    gated the reference's way (maxReplicas must EXCEED replicas), and
+    the release passes lint including the HPA checks."""
+    from devspace_tpu.deploy.chart import render_chart
+
+    cpu_chart = os.path.join(
+        os.path.dirname(__file__), "..", "devspace_tpu", "generator",
+        "templates", "chart-cpu",
+    )
+
+    def render(values):
+        return render_chart(
+            cpu_chart, release_name="web", namespace="default", values=values
+        )
+
+    hpas = [
+        m for m in render({"replicas": 2})
+        if m["kind"] == "HorizontalPodAutoscaler"
+    ]
+    assert hpas == [], "no autoscaling values -> no HPA"
+    hpas = [
+        m
+        for m in render(
+            {
+                "replicas": 2,
+                "autoscaling": {
+                    "horizontal": {"maxReplicas": 2, "averageCPU": 80}
+                },
+            }
+        )
+        if m["kind"] == "HorizontalPodAutoscaler"
+    ]
+    assert hpas == [], "maxReplicas <= replicas must gate the HPA off"
+    from devspace_tpu.deploy.chart import ChartError
+
+    with pytest.raises(ChartError, match="needs maxReplicas"):
+        render({"autoscaling": {"horizontal": {"averageCPU": 80}}})
+    with pytest.raises(ChartError, match="needs averageCPU"):
+        render({"autoscaling": {"horizontal": {"maxReplicas": 4}}})
+    ms = render(
+        {
+            "replicas": 2,
+            "autoscaling": {
+                "horizontal": {
+                    "maxReplicas": 6,
+                    "averageCPU": 75,
+                    "averageMemory": "512Mi",
+                }
+            },
+        }
+    )
+    hpa = next(m for m in ms if m["kind"] == "HorizontalPodAutoscaler")
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    assert hpa["spec"]["scaleTargetRef"] == {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "name": "web",
+    }
+    assert hpa["spec"]["minReplicas"] == 2
+    assert hpa["spec"]["maxReplicas"] == 6
+    by_name = {m["resource"]["name"]: m["resource"] for m in hpa["spec"]["metrics"]}
+    assert by_name["cpu"]["target"] == {
+        "type": "Utilization",
+        "averageUtilization": 75,
+    }
+    assert by_name["memory"]["target"] == {
+        "type": "AverageValue",
+        "averageValue": "512Mi",
+    }
+    assert validate_manifests(ms) == []
+
+
+def test_lint_hpa_structural_checks():
+    base = {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "web"},
+    }
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "web"},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": "m", "image": "x:y"}]}
+            }
+        },
+    }
+    good = {
+        **base,
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1", "kind": "Deployment", "name": "web",
+            },
+            "minReplicas": 1,
+            "maxReplicas": 4,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    assert validate_manifests([dep, good]) == []
+    dangling = {
+        **base,
+        "spec": {
+            "scaleTargetRef": {"kind": "Deployment", "name": "ghost"},
+            "maxReplicas": 4,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    issues = validate_manifests([dep, dangling])
+    assert any("not among the rendered objects" in i for i in issues)
+    inverted = {
+        **base,
+        "spec": {
+            "scaleTargetRef": {"kind": "Deployment", "name": "web"},
+            "minReplicas": 5,
+            "maxReplicas": 2,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    issues = validate_manifests([dep, inverted])
+    assert any("minReplicas 5 > maxReplicas 2" in i for i in issues)
+    metricless = {
+        **base,
+        "spec": {
+            "scaleTargetRef": {"kind": "Deployment", "name": "web"},
+            "maxReplicas": 4,
+        },
+    }
+    issues = validate_manifests([dep, metricless])
+    assert any("no metrics" in i for i in issues)
+    stringy = {
+        **base,
+        "spec": {
+            "scaleTargetRef": {"kind": "Deployment", "name": "web"},
+            "minReplicas": "2",
+            "maxReplicas": 4,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    issues = validate_manifests([dep, stringy])
+    assert any("minReplicas must be an integer" in i for i in issues)
+
+
+def test_lint_hpa_rejects_multihost_slice_target():
+    """TPU-first autoscaling semantics: a multi-host slice's worker count
+    is topology (static TPU_WORKER_HOSTNAMES roster) — an HPA pointing
+    at it must be flagged; a single-host slice workload may scale (each
+    replica is an independent server on its own TPU host)."""
+    def slice_sts(workers):
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": "srv"},
+            "spec": {
+                "serviceName": "srv",
+                "replicas": workers,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "m",
+                                "image": "x:y",
+                                "resources": {"limits": {"google.com/tpu": 4}},
+                                "env": [
+                                    {"name": "TPU_WORKER_ID", "value": "0"},
+                                    {
+                                        "name": "TPU_WORKER_HOSTNAMES",
+                                        "value": ",".join(
+                                            f"srv-{i}.srv" for i in range(workers)
+                                        ),
+                                    },
+                                    {
+                                        "name": "JAX_COORDINATOR_ADDRESS",
+                                        "value": "srv-0.srv:8476",
+                                    },
+                                ],
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+
+    hpa = {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "srv"},
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1", "kind": "StatefulSet", "name": "srv",
+            },
+            "maxReplicas": 8,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    multi = TPUConfig(workers=2, chips_per_worker=4)
+    issues = lint_tpu_consistency([slice_sts(2), hpa], multi)
+    assert any("topology, not load" in i for i in issues)
+    single = TPUConfig(workers=1, chips_per_worker=4)
+    issues = lint_tpu_consistency([slice_sts(1), hpa], single)
+    assert not any("topology, not load" in i for i in issues)
