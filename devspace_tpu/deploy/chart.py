@@ -190,7 +190,54 @@ def render_chart(
 
     if not manifests:
         raise ChartError(f"chart {chart_path} rendered no manifests")
+    _check_hpa_slice_conflict(manifests)
     return manifests
+
+
+def _check_hpa_slice_conflict(manifests: list[dict]) -> None:
+    """Render-time hard error (every render path — deploy, print, lint —
+    goes through here): an HPA must never target a MULTI-host slice
+    workload, whose worker count is topology (the static
+    TPU_WORKER_HOSTNAMES roster), not load. Detected from the manifests
+    alone so it holds even when no tpu config is in scope; deploy()
+    performs no lint, so this is what stops the HPA from shrinking a
+    slice below its roster. Single-host workloads may scale (each
+    replica is an independent server on its own TPU host)."""
+    rosters: dict[tuple[str, str], int] = {}
+    for doc in manifests:
+        if not isinstance(doc, dict):
+            continue
+        spec = doc.get("spec") or {}
+        tmpl = ((spec.get("template") or {}).get("spec")) or {}
+        for c in tmpl.get("containers") or []:
+            for e in c.get("env") or []:
+                if (
+                    isinstance(e, dict)
+                    and e.get("name") == "TPU_WORKER_HOSTNAMES"
+                    and isinstance(e.get("value"), str)
+                ):
+                    hosts = len([h for h in e["value"].split(",") if h])
+                    rosters[
+                        (str(doc.get("kind")),
+                         str((doc.get("metadata") or {}).get("name")))
+                    ] = max(hosts, rosters.get(
+                        (str(doc.get("kind")),
+                         str((doc.get("metadata") or {}).get("name"))), 0))
+    for doc in manifests:
+        if (
+            not isinstance(doc, dict)
+            or doc.get("kind") != "HorizontalPodAutoscaler"
+        ):
+            continue
+        ref = ((doc.get("spec") or {}).get("scaleTargetRef")) or {}
+        hosts = rosters.get((str(ref.get("kind")), str(ref.get("name"))), 0)
+        if hosts > 1:
+            raise ChartError(
+                f"autoscaling: HPA targets {ref.get('kind')}/"
+                f"{ref.get('name')}, a {hosts}-host TPU slice — slice "
+                f"worker count is topology, not load; horizontal scaling "
+                f"fits single-host serving replicas only"
+            )
 
 
 def _derive_persistence(values: dict) -> None:
@@ -276,6 +323,9 @@ def _derive_autoscaling(values: dict) -> None:
     absent), like the persistence derivations above."""
     auto = values.get("autoscaling")
     if not isinstance(auto, dict):
+        # `autoscaling: null` is the standard disable-override idiom —
+        # normalize so the hpa.yaml for-each lookup still resolves
+        values["autoscaling"] = {"objects": []}
         return
     hor = auto.get("horizontal")
     if not isinstance(hor, dict) or not hor:
@@ -296,11 +346,9 @@ def _derive_autoscaling(values: dict) -> None:
         raise ChartError(
             f"autoscaling.horizontal.maxReplicas must be an integer: {e}"
         ) from e
-    if max_replicas <= replicas:
-        # the reference's gt-gate: an HPA capped at or below the static
-        # replica count could only fight the Deployment
-        auto.setdefault("objects", [])
-        return
+    # metrics validate BEFORE the render gate: a bad averageCPU must fail
+    # at authoring time, not months later when someone lowers replicas
+    # and the gate flips on
     metrics = []
     if hor.get("averageCPU") is not None:
         try:
@@ -340,6 +388,11 @@ def _derive_autoscaling(values: dict) -> None:
             "autoscaling.horizontal needs averageCPU and/or averageMemory "
             "(an HPA without metrics cannot scale)"
         )
+    if max_replicas <= replicas:
+        # the reference's gt-gate: an HPA capped at or below the static
+        # replica count could only fight the Deployment
+        auto.setdefault("objects", [])
+        return
     auto.setdefault(
         "objects",
         [

@@ -203,7 +203,12 @@ def validate_manifests(docs: list[dict]) -> list[str]:
                 )
             elif min_r < 1:
                 issues.append(f"{label}: minReplicas must be >= 1 ({min_r})")
-            if not spec.get("metrics"):
+            # v2-only: autoscaling/v1 scales via
+            # spec.targetCPUUtilizationPercentage and has no metrics list
+            # (vendored upstream charts legitimately render v1 objects)
+            if str(api).startswith("autoscaling/v2") and not spec.get(
+                "metrics"
+            ):
                 issues.append(
                     f"{label}: no metrics — the HPA could never scale"
                 )

@@ -1,0 +1,145 @@
+"""Conv-shaped MXU ceilings for the ResNet MFU defense (docs/PERF.md).
+
+The matmul-chain roofline argues the ResNet step sits at this chip's
+demonstrated ceiling — but square matmuls are a different MXU
+utilization regime than ResNet's 64–512-channel convolutions. This
+measures the ACTUAL conv shapes of stages 1–4 (batch-256 NHWC bf16, the
+headline config) the same strict-sync way: in-program ``lax.fori_loop``
+repetition threading the activation through each conv (the tunnel's
+identical-dispatch dedup makes loosely-chained timing loops lie — see
+PERF.md Methodology), distinct inputs per timed call, one
+``block_until_ready`` per measurement.
+
+Prints a table to stderr and one JSON line to stdout:
+``{"conv_ceilings_tflops": {shape: best_of_3}, ...}``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax import lax
+
+BATCH = int(os.environ.get("BENCH_CONV_BATCH", 256))
+REPS = int(os.environ.get("BENCH_CONV_REPS", 100))
+DN = ("NHWC", "HWIO", "NHWC")
+
+# (name, spatial, c_in, c_out, kernel) — the FLOP-dominant convs of each
+# ResNet-50 stage at batch 256. Unequal-channel 1x1s run as an
+# expand/contract PAIR so the activation threads through the loop.
+SHAPES = [
+    ("stage1_3x3_64ch_56px", 56, 64, 64, 3),
+    ("stage2_3x3_128ch_28px", 28, 128, 128, 3),
+    ("stage3_3x3_256ch_14px", 14, 256, 256, 3),
+    ("stage4_3x3_512ch_7px", 7, 512, 512, 3),
+    ("stage1_1x1_64to256_56px", 56, 64, 256, 1),
+    ("stage4_1x1_512to2048_7px", 7, 512, 2048, 1),
+]
+
+
+def chain(h, cin, cout, k, bn=False):
+    """jitted fn: REPS conv applications threading the activation; the
+    init-style weight scale (1/sqrt(fan_in)) keeps magnitudes sane in
+    bf16 across the whole chain. ``bn=True`` appends training-form
+    BatchNorm (batch statistics over N,H,W — the HBM-bound reduction the
+    real model pays) + ReLU after each conv; reported TF/s still counts
+    CONV flops only, so the drop vs the bare chain IS the BN/ReLU cost
+    in roofline terms."""
+    kw = jax.random.PRNGKey(0)
+    scale_up = (k * k * cin) ** -0.5
+    w_up = (
+        jax.random.normal(kw, (k, k, cin, cout), jnp.float32) * scale_up
+    ).astype(jnp.bfloat16)
+    w_down = None
+    if cin != cout:
+        w_down = (
+            jax.random.normal(kw, (1, 1, cout, cin), jnp.float32)
+            * cout ** -0.5
+        ).astype(jnp.bfloat16)
+
+    def norm_relu(z):
+        mean = jnp.mean(z.astype(jnp.float32), axis=(0, 1, 2))
+        var = jnp.var(z.astype(jnp.float32), axis=(0, 1, 2))
+        z = (z - mean.astype(z.dtype)) * jax.lax.rsqrt(
+            var + 1e-5
+        ).astype(z.dtype)
+        return jax.nn.relu(z)
+
+    def body(_, y):
+        z = lax.conv_general_dilated(
+            y, w_up, (1, 1), "SAME", dimension_numbers=DN
+        )
+        if bn:
+            z = norm_relu(z)
+        if w_down is None:
+            return z
+        z = lax.conv_general_dilated(
+            z, w_down, (1, 1), "SAME", dimension_numbers=DN
+        )
+        return norm_relu(z) if bn else z
+
+    fn = jax.jit(lambda x: lax.fori_loop(0, REPS, body, x))
+    per_iter = 2 * BATCH * h * h * cin * cout * k * k
+    if w_down is not None:
+        per_iter *= 2  # the contraction leg mirrors the expansion leg
+    return fn, per_iter * REPS
+
+
+def measure(name, h, cin, cout, k, bn=False) -> float:
+    fn, flops = chain(h, cin, cout, k, bn=bn)
+    xs = [
+        jax.random.normal(jax.random.PRNGKey(i + 1), (BATCH, h, h, cin)).astype(
+            jnp.bfloat16
+        )
+        for i in range(4)
+    ]
+    jax.block_until_ready(fn(xs[0]))  # compile + warm (not timed)
+    best = 0.0
+    for x in xs[1:]:  # distinct inputs: distinct dispatches (no dedup)
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(x))
+        dt = time.monotonic() - t0
+        best = max(best, flops / dt / 1e12)
+    print(f"[conv] {name}: {best:.1f} TF/s ({flops / 1e12:.2f} TFLOP/call)",
+          file=sys.stderr)
+    return round(best, 1)
+
+
+def main():
+    dev = jax.devices()[0]
+    results = {
+        name: measure(name, h, cin, cout, k)
+        for name, h, cin, cout, k in SHAPES
+    }
+    # the fused regime the model actually runs: conv + training-BN + relu
+    # (TF/s still counts conv flops — the drop is the BN/ReLU HBM cost)
+    bn_results = {
+        name: measure(name + "_bnrelu", h, cin, cout, k, bn=True)
+        for name, h, cin, cout, k in SHAPES
+        if k == 3
+    }
+    print(
+        json.dumps(
+            {
+                "conv_ceilings_tflops": results,
+                "conv_bn_relu_ceilings_tflops": bn_results,
+                "batch": BATCH,
+                "reps_per_call": REPS,
+                "platform": dev.platform,
+                "device_kind": dev.device_kind,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

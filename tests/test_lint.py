@@ -697,3 +697,113 @@ def test_lint_hpa_rejects_multihost_slice_target():
     single = TPUConfig(workers=1, chips_per_worker=4)
     issues = lint_tpu_consistency([slice_sts(1), hpa], single)
     assert not any("topology, not load" in i for i in issues)
+
+
+def test_autoscaling_null_override_disables_cleanly():
+    """`autoscaling: null` — the standard disable-override idiom — must
+    render with no HPA, not crash the for-each lookup."""
+    from devspace_tpu.deploy.chart import render_chart
+
+    example = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "kaniko", "chart"
+    )
+    ms = render_chart(
+        example, release_name="k", namespace="default",
+        values={"image": "x:y", "autoscaling": None},
+        extra_context={"images": {}, "pullSecrets": [], "tpu": {}},
+    )
+    assert not [m for m in ms if m["kind"] == "HorizontalPodAutoscaler"]
+
+
+def test_autoscaling_metric_errors_surface_even_when_gated_off():
+    """A bad averageCPU must fail at authoring time even while the
+    maxReplicas gate keeps the HPA un-rendered."""
+    from devspace_tpu.deploy.chart import ChartError, render_chart
+
+    cpu_chart = os.path.join(
+        os.path.dirname(__file__), "..", "devspace_tpu", "generator",
+        "templates", "chart-cpu",
+    )
+    with pytest.raises(ChartError, match="averageCPU must be an integer"):
+        render_chart(
+            cpu_chart, release_name="w", namespace="default",
+            values={
+                "replicas": 2,
+                "autoscaling": {
+                    "horizontal": {"maxReplicas": 2, "averageCPU": "eighty"}
+                },
+            },
+        )
+
+
+def test_render_refuses_hpa_on_multihost_slice():
+    """The chart-tpu HPA + a multi-host slice must fail AT RENDER TIME
+    (deploy performs no lint): an HPA would shrink the slice below its
+    static TPU_WORKER_HOSTNAMES roster. Single-host renders fine."""
+    from devspace_tpu.deploy.chart import ChartError, render_chart
+
+    tpu_chart = os.path.join(
+        os.path.dirname(__file__), "..", "devspace_tpu", "generator",
+        "templates", "chart-tpu",
+    )
+
+    def ctx(workers):
+        hosts = ",".join(f"t-{i}.t" for i in range(workers))
+        return {
+            "images": {},
+            "pullSecrets": [],
+            "tpu": {
+                "accelerator": "v5litepod-8",
+                "topology": "2x4",
+                "workers": workers,
+                "chipsPerWorker": 4,
+                "runtimeVersion": "",
+                "workerHostnames": hosts,
+                "coordinatorAddress": "t-0.t:8476",
+            },
+        }
+
+    vals = {
+        "image": "x:y",
+        "autoscaling": {"horizontal": {"maxReplicas": 5, "averageCPU": 80}},
+    }
+    with pytest.raises(ChartError, match="topology, not load"):
+        render_chart(
+            tpu_chart, release_name="t", namespace="default",
+            values=vals, extra_context=ctx(2),
+        )
+    ms = render_chart(
+        tpu_chart, release_name="t", namespace="default",
+        values=vals, extra_context=ctx(1),
+    )
+    assert any(m["kind"] == "HorizontalPodAutoscaler" for m in ms)
+
+
+def test_lint_accepts_autoscaling_v1_hpa():
+    """autoscaling/v1 HPAs (vendored upstream charts) scale via
+    targetCPUUtilizationPercentage and have no metrics list — lint must
+    not flag them."""
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "web"},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": "m", "image": "x:y"}]}
+            }
+        },
+    }
+    v1 = {
+        "apiVersion": "autoscaling/v1",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "web"},
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1", "kind": "Deployment", "name": "web",
+            },
+            "minReplicas": 1,
+            "maxReplicas": 3,
+            "targetCPUUtilizationPercentage": 80,
+        },
+    }
+    assert validate_manifests([dep, v1]) == []
