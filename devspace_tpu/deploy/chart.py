@@ -207,6 +207,10 @@ def _check_hpa_slice_conflict(manifests: list[dict]) -> None:
     for doc in manifests:
         if not isinstance(doc, dict):
             continue
+        key = (
+            str(doc.get("kind")),
+            str((doc.get("metadata") or {}).get("name")),
+        )
         spec = doc.get("spec") or {}
         tmpl = ((spec.get("template") or {}).get("spec")) or {}
         for c in tmpl.get("containers") or []:
@@ -217,12 +221,7 @@ def _check_hpa_slice_conflict(manifests: list[dict]) -> None:
                     and isinstance(e.get("value"), str)
                 ):
                     hosts = len([h for h in e["value"].split(",") if h])
-                    rosters[
-                        (str(doc.get("kind")),
-                         str((doc.get("metadata") or {}).get("name")))
-                    ] = max(hosts, rosters.get(
-                        (str(doc.get("kind")),
-                         str((doc.get("metadata") or {}).get("name"))), 0))
+                    rosters[key] = max(hosts, rosters.get(key, 0))
     for doc in manifests:
         if (
             not isinstance(doc, dict)
@@ -383,16 +382,19 @@ def _derive_autoscaling(values: dict) -> None:
                 },
             }
         )
+    if max_replicas <= replicas:
+        # the reference's gt-gate: an HPA capped at or below the static
+        # replica count could only fight the Deployment. Gated-off
+        # configs may omit metrics entirely (lowering maxReplicas is a
+        # legitimate disable idiom) — only VALUE malformation above
+        # fails at authoring time.
+        auto.setdefault("objects", [])
+        return
     if not metrics:
         raise ChartError(
             "autoscaling.horizontal needs averageCPU and/or averageMemory "
             "(an HPA without metrics cannot scale)"
         )
-    if max_replicas <= replicas:
-        # the reference's gt-gate: an HPA capped at or below the static
-        # replica count could only fight the Deployment
-        auto.setdefault("objects", [])
-        return
     auto.setdefault(
         "objects",
         [

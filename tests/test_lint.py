@@ -734,6 +734,18 @@ def test_autoscaling_metric_errors_surface_even_when_gated_off():
                 },
             },
         )
+    # gated-off WITHOUT metrics is the lower-maxReplicas disable idiom —
+    # it must render cleanly (metrics absence only matters when the gate
+    # is on); raising it only when an HPA would render keeps old values
+    # files working
+    ms = render_chart(
+        cpu_chart, release_name="w", namespace="default",
+        values={
+            "replicas": 2,
+            "autoscaling": {"horizontal": {"maxReplicas": 2}},
+        },
+    )
+    assert not [m for m in ms if m["kind"] == "HorizontalPodAutoscaler"]
 
 
 def test_render_refuses_hpa_on_multihost_slice():
