@@ -1,0 +1,98 @@
+"""First-request TTFT: cold engine vs prewarm() (docs/PERF.md).
+
+prewarm() moves every XLA compile to startup; the observable win is the
+FIRST request no longer paying compile in its TTFT. Two engines on the
+bench config, same prompt: (a) cold — first submit compiles its prefill
+bucket + decode chunk inline; (b) prewarmed — compiles happen before
+start(), timed separately. One TPU process at a time; run alone.
+
+Prints one JSON line: cold/prewarmed first-token latency + prewarm cost.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the image's sitecustomize pre-imports jax and freezes the platform
+    # default at interpreter startup — the env var alone is too late
+    # (same workaround as bench_inference.py / tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from devspace_tpu.inference import InferenceEngine
+from devspace_tpu.models import transformer as tfm
+
+CFG = tfm.TransformerConfig(
+    vocab_size=32_000,
+    dim=int(os.environ.get("BENCH_DIM", 1024)),
+    n_layers=int(os.environ.get("BENCH_LAYERS", 8)),
+    n_heads=8,
+    n_kv_heads=8,
+    ffn_dim=int(os.environ.get("BENCH_FFN", 2816)),
+    max_seq_len=1024,
+)
+
+
+def first_token_latency(engine) -> float:
+    prompt = list(np.random.default_rng(0).integers(1, 1000, size=100))
+    t0 = time.monotonic()
+    h = engine.submit(prompt, 8)
+    while not h.tokens:
+        if h.done.is_set():
+            h.result(timeout=1)
+            break
+        time.sleep(0.002)
+    dt = time.monotonic() - t0
+    h.result(timeout=600)
+    return dt
+
+
+def main():
+    params = tfm.init_params(CFG, jax.random.PRNGKey(0))
+    kw = dict(max_slots=8, max_len=256, prefill_chunk=128)
+
+    cold = InferenceEngine(params, CFG, **kw).start()
+    try:
+        cold_ttft = first_token_latency(cold)
+    finally:
+        cold.stop()
+    print(f"[prewarm-bench] cold first-request TTFT {cold_ttft:.2f}s",
+          file=sys.stderr)
+
+    warm = InferenceEngine(params, CFG, **kw)
+    t0 = time.monotonic()
+    timings = warm.prewarm()
+    prewarm_s = time.monotonic() - t0
+    warm.start()
+    try:
+        warm_ttft = first_token_latency(warm)
+    finally:
+        warm.stop()
+    print(
+        f"[prewarm-bench] prewarm {prewarm_s:.1f}s "
+        f"({len(timings)} programs), first-request TTFT {warm_ttft:.2f}s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "cold_first_request_ttft_s": round(cold_ttft, 2),
+                "prewarmed_first_request_ttft_s": round(warm_ttft, 2),
+                "prewarm_startup_s": round(prewarm_s, 1),
+                "programs_compiled": len(timings),
+                "platform": jax.devices()[0].platform,
+                "config": {"dim": CFG.dim, "layers": CFG.n_layers},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
