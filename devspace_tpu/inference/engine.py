@@ -201,6 +201,7 @@ class InferenceEngine:
         draft_params: Optional[dict] = None,
         draft_cfg: Optional[tfm.TransformerConfig] = None,
         spec_k: int = 4,
+        spec_depth: int = 1,
         kv_dtype: Optional[str] = None,
         prefix_cache: bool = True,
         prewarm: bool = False,
@@ -227,7 +228,22 @@ class InferenceEngine:
         decoding token-for-token) and never depends on draft-cache
         contents — a garbage draft only lowers acceptance — so draft
         state needs no preemption/recovery bookkeeping: preempted slots
-        simply re-prefill both models on re-admission.
+        simply re-prefill both models on re-admission. Losslessness is
+        an EXACT-ARITHMETIC property: in bf16 a near-tie logit (e.g.
+        inside a repeated-token cycle) can argmax-flip between the
+        block-verify and sequential-decode reductions — the same class
+        of tie-flip the int8 KV pool documents. f32 serving is
+        bit-lossless (pinned in tests).
+
+        ``spec_depth`` chains that many draft+verify rounds inside ONE
+        dispatch (``lax.scan``; acceptance is recomputed device-side to
+        advance each slot's positions between rounds) — committing up to
+        ``depth x (k+1)`` tokens per host round-trip. The host replays
+        the same acceptance rule on the returned proposals/choices, so
+        losslessness is unchanged; what changes is dispatch amortization,
+        the lever that matters on high-RTT links where per-dispatch
+        overhead, not compute, bounds speculative throughput
+        (docs/PERF.md "Speculative decoding with a TRAINED draft").
 
         ``kv_dtype="int8"`` stores the paged pool quantized (per-token
         per-head scales; ops.paged_attention.quantize_kv): K/V HBM
@@ -347,9 +363,12 @@ class InferenceEngine:
             raise ValueError("draft_params requires draft_cfg")
         if spec_k < 1 or spec_k > 16:
             raise ValueError("spec_k must be in 1..16")
+        if spec_depth < 1 or spec_depth > 16:
+            raise ValueError("spec_depth must be in 1..16")
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.spec_k = int(spec_k)
+        self.spec_depth = int(spec_depth)
         self.spec_rounds = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
@@ -546,7 +565,54 @@ class InferenceEngine:
                 choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return pool, d_cache, props, choices
 
-            self._spec_round_jit = jax.jit(spec_round, donate_argnums=(2, 3))
+            # ONE dispatch surface for every depth — scan length 1 IS the
+            # single round, so jit construction, prewarm and
+            # _run_spec_round never fork on spec_depth (forked positional
+            # signatures fail only at runtime when one site is missed)
+            depth = self.spec_depth
+
+            def spec_multi(
+                t_params, d_params, pool, d_cache, tables,
+                cur, pos0_d, pos0_v, active,
+            ):
+                """``depth`` chained rounds in one dispatch: the device
+                recomputes the SAME leading-match acceptance the host
+                commit loop applies, advancing each active slot's
+                current token and positions between rounds (parked
+                slots stay parked — ``active`` is False and their
+                positions never move). Rejected positions' K/V is
+                overwritten by the next round's writes before anything
+                attends it (write-before-read, as everywhere)."""
+
+                def body(carry, _):
+                    pool, d_cache, cur, pos_d, pos_v = carry
+                    pool, d_cache, props, choices = spec_round(
+                        t_params, d_params, pool, d_cache, tables,
+                        cur, pos_d, pos_v,
+                    )
+                    match = (props == choices[:, :k_spec]).astype(jnp.int32)
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    new_cur = jnp.take_along_axis(
+                        choices, n_acc[:, None], axis=1
+                    )[:, 0]
+                    step = n_acc + 1
+                    pos_d = jnp.where(active, pos_d + step, pos_d)
+                    pos_v = jnp.where(active, pos_v + step, pos_v)
+                    cur = jnp.where(active, new_cur, cur)
+                    return (pool, d_cache, cur, pos_d, pos_v), (
+                        props,
+                        choices,
+                    )
+
+                (pool, d_cache, _, _, _), (props_r, choices_r) = jax.lax.scan(
+                    body,
+                    (pool, d_cache, cur, pos0_d, pos0_v),
+                    None,
+                    length=depth,
+                )
+                return pool, d_cache, props_r, choices_r
+
+            self._spec_round_jit = jax.jit(spec_multi, donate_argnums=(2, 3))
 
             def draft_prefill(d_params, d_cache, tokens, slot_idx):
                 # one full-sequence draft forward (big MXU matmuls) seeds
@@ -756,6 +822,7 @@ class InferenceEngine:
                 zb,
                 jnp.full((B,), self.max_len, jnp.int32),  # parked draft pos
                 zb,
+                jnp.zeros((B,), bool),  # all parked
             )
             timings["spec_round"] = round(time.monotonic() - t0, 3)
         jax.block_until_ready(self.pool)
@@ -1355,12 +1422,16 @@ class InferenceEngine:
             # simply finishes on the plain path
             spec_idx: list[int] = []
             if self.draft_params is not None:
+                # a depth-R dispatch can advance R*(k+1) tokens; its last
+                # verify write lands at length-2 + R*(k+1), which must
+                # stay inside max_len (R=1 reduces to length+k <= max_len)
+                spec_span = self.spec_depth * (self.spec_k + 1)
                 spec_idx = [
                     i
                     for i in ready
                     if self.slots[i].req.temperature <= 0
                     and self.slots[i].draft_ready
-                    and self.slots[i].length + self.spec_k <= self.max_len
+                    and self.slots[i].length + spec_span - 1 <= self.max_len
                     # the spec round samples without the per-slot extras:
                     # biased slots would commit unbiased tokens, and
                     # min-length slots could commit suppressed EOS — both
@@ -1396,9 +1467,12 @@ class InferenceEngine:
                     ready.remove(i)
                     continue
                 if i in spec_idx:
-                    # verification writes positions length-1..length-1+k
-                    # (eligibility guarantees length+k <= max_len)
-                    need_upto = s.length + self.spec_k
+                    # verification writes reach position
+                    # length-2 + depth*(k+1) (eligibility bounds it
+                    # inside max_len); R=1 reduces to length+k
+                    need_upto = (
+                        s.length - 1 + self.spec_depth * (self.spec_k + 1)
+                    )
                 else:
                     # writes never pass max_len-1 (the decode scan clamps
                     # its positions), so coverage past max_len is never
@@ -1543,18 +1617,23 @@ class InferenceEngine:
             jnp.int32,
         )
         try:
-            self.pool, self._draft_cache, props, choices = self._spec_round_jit(
-                self.params,
-                self.draft_params,
-                self.pool,
-                self._draft_cache,
-                self._decode_tables(include=spec_set),
-                cur,
-                pos0_draft,
-                pos0_verify,
+            self.pool, self._draft_cache, props, choices = (
+                self._spec_round_jit(
+                    self.params,
+                    self.draft_params,
+                    self.pool,
+                    self._draft_cache,
+                    self._decode_tables(include=spec_set),
+                    cur,
+                    pos0_draft,
+                    pos0_verify,
+                    jnp.asarray(
+                        [i in spec_set for i in range(self.max_slots)]
+                    ),
+                )
             )
-            props = np.asarray(jax.device_get(props))  # [B, k]
-            choices = np.asarray(jax.device_get(choices))  # [B, k+1]
+            props = np.asarray(jax.device_get(props))  # [R, B, k]
+            choices = np.asarray(jax.device_get(choices))  # [R, B, k+1]
         except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
             # pool and draft cache were both donated into the failed call
             self._fail_outstanding(
@@ -1563,25 +1642,30 @@ class InferenceEngine:
             self._reset_pool()
             self._reset_draft_cache()
             return
-        self.spec_rounds += 1
+        self.spec_rounds += self.spec_depth
         k = self.spec_k
         for i in spec_idx:
-            match = props[i] == choices[i, :k]
-            a = int(k if match.all() else match.argmin())
-            # accepted/proposed measure the DRAFT-MATCH rate (the number
-            # the operator tunes draft choice and SPEC_K by) — raw a,
-            # not capped by how many tokens the request had room to
-            # commit; spec_committed counts actual emits
-            self.spec_proposed += k
-            self.spec_accepted += a
-            committed = 0
-            for j in range(a):
+            for r in range(self.spec_depth):
                 if self.slots[i].req is None:
-                    break  # hit EOS / max_new mid-commit
-                self._emit(i, int(props[i, j]))
-                committed += 1
-            if self.slots[i].req is not None:
-                # the target's corrected (a<k) or bonus (a==k) token
-                self._emit(i, int(choices[i, a]))
-                committed += 1
-            self.spec_committed += committed
+                    # finished mid-dispatch (EOS / max_new): the device's
+                    # later rounds for this slot are discarded speculation
+                    break
+                match = props[r, i] == choices[r, i, :k]
+                a = int(k if match.all() else match.argmin())
+                # accepted/proposed measure the DRAFT-MATCH rate (the
+                # number the operator tunes draft choice and SPEC_K by) —
+                # raw a, not capped by how many tokens the request had
+                # room to commit; spec_committed counts actual emits
+                self.spec_proposed += k
+                self.spec_accepted += a
+                committed = 0
+                for j in range(a):
+                    if self.slots[i].req is None:
+                        break  # hit EOS / max_new mid-commit
+                    self._emit(i, int(props[r, i, j]))
+                    committed += 1
+                if self.slots[i].req is not None:
+                    # the target's corrected (a<k) or bonus (a==k) token
+                    self._emit(i, int(choices[r, i, a]))
+                    committed += 1
+                self.spec_committed += committed

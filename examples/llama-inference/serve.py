@@ -116,6 +116,9 @@ class Server:
             draft_params=draft_params,
             draft_cfg=draft_cfg,
             spec_k=self.spec_k,
+            # SPEC_DEPTH chains that many draft/verify rounds per
+            # dispatch — the amortization lever for high-RTT links
+            spec_depth=int(os.environ.get("SPEC_DEPTH", 1)),
         )
         # PREWARM=1 compiles every prefill bucket / decode chunk / spec
         # program before the port opens — no mid-serving XLA compiles

@@ -246,6 +246,7 @@ def main():
                 draft_params=draft_params if trained else params,
                 draft_cfg=draft_cfg if trained else CFG,
                 spec_k=int(os.environ.get("BENCH_SPEC_K", 4)),
+                spec_depth=int(os.environ.get("BENCH_SPEC_DEPTH", 1)),
                 kv_dtype=KV_DTYPE,
             ).start()
         )
@@ -255,6 +256,7 @@ def main():
             "tok_per_sec": round(total_new / spec_s, 1),
             "vs_plain_engine": round(engine_s / spec_s, 2) if engine_s else None,
             "spec_k": int(os.environ.get("BENCH_SPEC_K", 4)),
+            "spec_depth": int(os.environ.get("BENCH_SPEC_DEPTH", 1)),
             "acceptance": round(st["spec_accepted"] / st["spec_proposed"], 4)
             if st["spec_proposed"]
             else 0.0,

@@ -63,6 +63,7 @@ def main():
         cold_ttft = first_token_latency(cold)
     finally:
         cold.stop()
+    del cold  # its donated-into pool must free before the warm engine's
     print(f"[prewarm-bench] cold first-request TTFT {cold_ttft:.2f}s",
           file=sys.stderr)
 

@@ -1326,3 +1326,90 @@ def test_prewarm_refuses_running_engine(params):
             engine.prewarm()
     finally:
         engine.stop()
+
+
+def test_spec_depth_multi_round_lossless(params):
+    """spec_depth>1 chains rounds inside one dispatch (device-side
+    acceptance advances positions between rounds) — the committed stream
+    must STILL equal plain greedy decoding token-for-token, across
+    prompts of different lengths, generation lengths that end mid-round
+    and mid-dispatch, and queue pressure.
+
+    f32 config: losslessness is an exact-arithmetic property; in bf16 a
+    near-tie inside a repeated-token cycle can flip between the
+    block-verify and sequential-decode reductions (seed 5's prompt 0
+    reproduces it at EVERY spec depth including 1 — not a multi-round
+    artifact; see the spec_depth docstring in engine.py)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, dtype=jnp.float32)
+    params32 = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    requests = [
+        (list(rng.integers(1, cfg.vocab_size, size=plen)), n)
+        for plen, n in [(3, 17), (7, 5), (1, 23), (12, 1), (5, 11)]
+    ]
+    engine = InferenceEngine(
+        params32, cfg, max_slots=2, max_len=96,
+        draft_params=params32, draft_cfg=cfg, spec_k=3, spec_depth=4,
+    ).start()
+    try:
+        handles = [engine.submit(p, n) for p, n in requests]
+        results = [h.result(timeout=120) for h in handles]
+    finally:
+        engine.stop()
+    assert engine.spec_rounds > 0, "the multi-round path must have run"
+    for (prompt, n), got in zip(requests, results):
+        ref = tfm.generate(
+            params32, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=n,
+        )
+        assert got == [int(t) for t in ref[0]], (
+            f"spec_depth=4 diverged for prompt len {len(prompt)}"
+        )
+
+
+def test_spec_depth_eos_mid_dispatch_and_composition(params):
+    """EOS inside an earlier round of a deep dispatch must end the
+    request with the device's later rounds discarded; composed with the
+    int8 KV pool + TP mesh the stream still matches the single-device
+    plain engine."""
+    prompt = [5, 9, 2]
+    ref = reference_generate(params, prompt, 12)
+    eos = ref[3]
+    want = ref[: ref.index(eos) + 1]
+    from devspace_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=96, mesh=mesh, kv_dtype="int8",
+        draft_params=params, draft_cfg=CFG, spec_k=3, spec_depth=3,
+    ).start()
+    try:
+        got = engine.submit(prompt, 12, eos_id=eos).result(timeout=120)
+        # a second request reuses the slot after the early finish
+        p2 = [2, 2, 2, 2]
+        got2 = engine.submit(p2, 6).result(timeout=120)
+    finally:
+        engine.stop()
+    assert got == want
+    assert got2 == reference_generate(params, p2, 6)
+
+
+def test_spec_depth_validation_and_eligibility_shrink(params):
+    with pytest.raises(ValueError, match="spec_depth"):
+        InferenceEngine(
+            params, CFG, draft_params=params, draft_cfg=CFG, spec_depth=0
+        )
+    # near max_len the deep dispatch no longer fits: the request must
+    # fall back to the plain path and still finish correctly
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=32,
+        draft_params=params, draft_cfg=CFG, spec_k=4, spec_depth=3,
+    ).start()
+    try:
+        prompt = [5, 1, 4, 2, 6, 3, 1, 1]  # 8 + 20 > eligibility span
+        got = engine.submit(prompt, 20).result(timeout=120)
+    finally:
+        engine.stop()
+    assert got == reference_generate(params, prompt, 20)
