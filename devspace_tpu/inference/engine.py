@@ -536,23 +536,29 @@ class InferenceEngine:
         )
 
         if draft_params is not None:
-            from .speculative import _draft_propose
+            from .speculative import _draft_propose_sampled, spec_accept_commit
 
             k_spec = self.spec_k
 
             def spec_round(
-                t_params, d_params, pool, d_cache, tables, cur, pos0_d, pos0_v
+                t_params, d_params, pool, d_cache, tables,
+                cur, pos0_d, pos0_v, keys, temps,
             ):
                 """One fused speculative round over the full slot batch:
-                draft-propose k tokens (dense per-slot cache, scan) +
-                ONE paged verification block on the target — a single
-                host round-trip commits 1..k+1 tokens per eligible slot.
-                Parked slots ride along with zeroed tables, draft
-                positions in the scratch tail (pos0_d=max_len) and
-                verify positions at 0 (scratch block 0); their outputs
-                are discarded. Active slots have pos0_d == pos0_v."""
-                props, d_cache = _draft_propose(
-                    d_params, d_cache, cur, pos0_d, draft_cfg, k_spec
+                draft-propose k tokens (dense per-slot cache, scan;
+                SAMPLED for temps > 0 rows, argmax otherwise) + ONE
+                paged verification block on the target, then the
+                accept/correct rule (speculative.spec_accept_commit:
+                exact greedy matching, or Leviathan sampling — lossless
+                in distribution) — a single host round-trip commits
+                1..k+1 tokens per eligible slot. Parked slots ride
+                along with zeroed tables, draft positions in the
+                scratch tail (pos0_d=max_len) and verify positions at 0
+                (scratch block 0); their outputs are discarded. Active
+                slots have pos0_d == pos0_v."""
+                props, d_probs, d_cache, keys = _draft_propose_sampled(
+                    d_params, d_cache, cur, pos0_d, draft_cfg, k_spec,
+                    keys, temps,
                 )
                 block = jnp.concatenate([cur[:, None], props], axis=1)
                 positions = (
@@ -562,8 +568,10 @@ class InferenceEngine:
                 logits, pool = tfm.decode_block_paged(
                     t_params, pool, tables, block, positions, cfg, tp=self._tp
                 )
-                choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return pool, d_cache, props, choices
+                commit, n_commit, keys = spec_accept_commit(
+                    props, d_probs, logits, temps, keys
+                )
+                return pool, d_cache, commit, n_commit, keys
 
             # ONE dispatch surface for every depth — scan length 1 IS the
             # single round, so jit construction, prewarm and
@@ -573,44 +581,47 @@ class InferenceEngine:
 
             def spec_multi(
                 t_params, d_params, pool, d_cache, tables,
-                cur, pos0_d, pos0_v, active,
+                cur, pos0_d, pos0_v, keys, temps, active,
             ):
-                """``depth`` chained rounds in one dispatch: the device
-                recomputes the SAME leading-match acceptance the host
-                commit loop applies, advancing each active slot's
-                current token and positions between rounds (parked
-                slots stay parked — ``active`` is False and their
-                positions never move). Rejected positions' K/V is
+                """``depth`` chained rounds in one dispatch: the commit
+                decision (greedy match or Leviathan acceptance) runs
+                device-side, advancing each active slot's current token
+                and positions between rounds (parked slots stay parked
+                — ``active`` is False and their positions never move).
+                The host emits exactly the returned commit tokens, so
+                losslessness properties are those of
+                spec_accept_commit. Rejected positions' K/V is
                 overwritten by the next round's writes before anything
                 attends it (write-before-read, as everywhere)."""
 
                 def body(carry, _):
-                    pool, d_cache, cur, pos_d, pos_v = carry
-                    pool, d_cache, props, choices = spec_round(
+                    pool, d_cache, cur, pos_d, pos_v, keys = carry
+                    pool, d_cache, commit, n_commit, keys = spec_round(
                         t_params, d_params, pool, d_cache, tables,
-                        cur, pos_d, pos_v,
+                        cur, pos_d, pos_v, keys, temps,
                     )
-                    match = (props == choices[:, :k_spec]).astype(jnp.int32)
-                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    # the correction/bonus token (last committed) seeds
+                    # the next round
                     new_cur = jnp.take_along_axis(
-                        choices, n_acc[:, None], axis=1
+                        commit, (n_commit - 1)[:, None], axis=1
                     )[:, 0]
-                    step = n_acc + 1
-                    pos_d = jnp.where(active, pos_d + step, pos_d)
-                    pos_v = jnp.where(active, pos_v + step, pos_v)
+                    pos_d = jnp.where(active, pos_d + n_commit, pos_d)
+                    pos_v = jnp.where(active, pos_v + n_commit, pos_v)
                     cur = jnp.where(active, new_cur, cur)
-                    return (pool, d_cache, cur, pos_d, pos_v), (
-                        props,
-                        choices,
+                    return (pool, d_cache, cur, pos_d, pos_v, keys), (
+                        commit,
+                        n_commit,
                     )
 
-                (pool, d_cache, _, _, _), (props_r, choices_r) = jax.lax.scan(
-                    body,
-                    (pool, d_cache, cur, pos0_d, pos0_v),
-                    None,
-                    length=depth,
+                (pool, d_cache, _, _, _, keys), (commit_r, n_r) = (
+                    jax.lax.scan(
+                        body,
+                        (pool, d_cache, cur, pos0_d, pos0_v, keys),
+                        None,
+                        length=depth,
+                    )
                 )
-                return pool, d_cache, props_r, choices_r
+                return pool, d_cache, keys, commit_r, n_r
 
             self._spec_round_jit = jax.jit(spec_multi, donate_argnums=(2, 3))
 
@@ -813,16 +824,20 @@ class InferenceEngine:
                 )
                 timings[f"draft_prefill_{c}"] = round(time.monotonic() - t0, 3)
             t0 = time.monotonic()
-            self.pool, self._draft_cache, _, _ = self._spec_round_jit(
-                self.params,
-                self.draft_params,
-                self.pool,
-                self._draft_cache,
-                zero_tables,
-                zb,
-                jnp.full((B,), self.max_len, jnp.int32),  # parked draft pos
-                zb,
-                jnp.zeros((B,), bool),  # all parked
+            self.pool, self._draft_cache, self._keys, _, _ = (
+                self._spec_round_jit(
+                    self.params,
+                    self.draft_params,
+                    self.pool,
+                    self._draft_cache,
+                    zero_tables,
+                    zb,
+                    jnp.full((B,), self.max_len, jnp.int32),  # parked pos
+                    zb,
+                    self._keys,
+                    jnp.zeros((B,), jnp.float32),
+                    jnp.zeros((B,), bool),  # all parked
+                )
             )
             timings["spec_round"] = round(time.monotonic() - t0, 3)
         jax.block_until_ready(self.pool)
@@ -1223,7 +1238,13 @@ class InferenceEngine:
             first = sample_logits(
                 sub, lg, req.temperature, req.top_k, req.top_p
             )
-            if self.draft_params is not None and req.temperature <= 0:
+            if self.draft_params is not None and (
+                req.temperature <= 0
+                or (req.top_k == 0 and req.top_p >= 1.0)
+            ):
+                # greedy OR plain temperature sampling can ride the
+                # speculative path (filtered sampling cannot — see the
+                # eligibility comment in _loop)
                 self._draft_prefill(slot_idx)
             slot.ready = True
             self._emit(slot_idx, int(first))
@@ -1429,7 +1450,19 @@ class InferenceEngine:
                 spec_idx = [
                     i
                     for i in ready
-                    if self.slots[i].req.temperature <= 0
+                    # greedy, or PLAIN temperature sampling (speculative
+                    # sampling accepts/resamples against the target's
+                    # temperature distribution — lossless in
+                    # distribution); top-k/top-p filters reshape p_t in
+                    # ways the accept rule doesn't model, so filtered
+                    # slots take the plain path
+                    if (
+                        self.slots[i].req.temperature <= 0
+                        or (
+                            self.slots[i].req.top_k == 0
+                            and self.slots[i].req.top_p >= 1.0
+                        )
+                    )
                     and self.slots[i].draft_ready
                     and self.slots[i].length + spec_span - 1 <= self.max_len
                     # the spec round samples without the per-slot extras:
@@ -1616,24 +1649,37 @@ class InferenceEngine:
             ],
             jnp.int32,
         )
+        temps = jnp.asarray(
+            [
+                (s.req.temperature if i in spec_set else 0.0)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.float32,
+        )
         try:
-            self.pool, self._draft_cache, props, choices = (
-                self._spec_round_jit(
-                    self.params,
-                    self.draft_params,
-                    self.pool,
-                    self._draft_cache,
-                    self._decode_tables(include=spec_set),
-                    cur,
-                    pos0_draft,
-                    pos0_verify,
-                    jnp.asarray(
-                        [i in spec_set for i in range(self.max_slots)]
-                    ),
-                )
+            (
+                self.pool,
+                self._draft_cache,
+                self._keys,
+                commit,
+                n_commit,
+            ) = self._spec_round_jit(
+                self.params,
+                self.draft_params,
+                self.pool,
+                self._draft_cache,
+                self._decode_tables(include=spec_set),
+                cur,
+                pos0_draft,
+                pos0_verify,
+                self._keys,
+                temps,
+                jnp.asarray(
+                    [i in spec_set for i in range(self.max_slots)]
+                ),
             )
-            props = np.asarray(jax.device_get(props))  # [R, B, k]
-            choices = np.asarray(jax.device_get(choices))  # [R, B, k+1]
+            commit = np.asarray(jax.device_get(commit))  # [R, B, k+1]
+            n_commit = np.asarray(jax.device_get(n_commit))  # [R, B]
         except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
             # pool and draft cache were both donated into the failed call
             self._fail_outstanding(
@@ -1650,22 +1696,17 @@ class InferenceEngine:
                     # finished mid-dispatch (EOS / max_new): the device's
                     # later rounds for this slot are discarded speculation
                     break
-                match = props[r, i] == choices[r, i, :k]
-                a = int(k if match.all() else match.argmin())
+                n = int(n_commit[r, i])
                 # accepted/proposed measure the DRAFT-MATCH rate (the
                 # number the operator tunes draft choice and SPEC_K by) —
-                # raw a, not capped by how many tokens the request had
+                # raw n-1, not capped by how many tokens the request had
                 # room to commit; spec_committed counts actual emits
                 self.spec_proposed += k
-                self.spec_accepted += a
+                self.spec_accepted += n - 1
                 committed = 0
-                for j in range(a):
+                for j in range(n):
                     if self.slots[i].req is None:
                         break  # hit EOS / max_new mid-commit
-                    self._emit(i, int(props[r, i, j]))
-                    committed += 1
-                if self.slots[i].req is not None:
-                    # the target's corrected (a<k) or bonus (a==k) token
-                    self._emit(i, int(choices[r, i, a]))
+                    self._emit(i, int(commit[r, i, j]))
                     committed += 1
                 self.spec_committed += committed

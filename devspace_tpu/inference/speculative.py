@@ -75,6 +75,117 @@ def _draft_propose(params, cache, cur, pos0, cfg, k):
     return jnp.moveaxis(props, 0, 1)[:, :k], cache  # [B, k]
 
 
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def _draft_propose_sampled(params, cache, cur, pos0, cfg, k, keys, temps):
+    """Propose k tokens per sequence, SAMPLING rows with temps > 0
+    (temperature-scaled categorical) and argmaxing the rest ->
+    (proposals [B, k], draft probs [B, k, V], cache, keys). The probs
+    are the draft's full temperature distribution per proposal position
+    — what the Leviathan residual needs at rejection. Same k+1-step
+    scan as :func:`_draft_propose` (the extra step seals the last
+    proposal's K/V)."""
+    safe_t = jnp.maximum(temps, 1e-6)[:, None]
+
+    def step(carry, j):
+        cache, cur, keys = carry
+        logits, kv = tfm.decode_tokens(params, cache, cur, pos0 + j, cfg)
+        probs = jax.nn.softmax(logits / safe_t, axis=-1)
+        split = jax.vmap(jax.random.split)(keys)
+        keys, subs = split[:, 0], split[:, 1]
+        sampled = jax.vmap(
+            lambda s, p: jax.random.categorical(
+                s, jnp.log(jnp.maximum(p, 1e-30))
+            )
+        )(subs, probs).astype(jnp.int32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
+        new_cache = {
+            "k": kv["k"], "v": kv["v"], "length": cache["length"],
+        }
+        return (new_cache, nxt, keys), (nxt, probs)
+
+    (cache, _, keys), (props, probs) = jax.lax.scan(
+        step, (cache, cur, keys), jnp.arange(k + 1, dtype=jnp.int32)
+    )
+    return (
+        jnp.moveaxis(props, 0, 1)[:, :k],
+        jnp.moveaxis(probs, 0, 1)[:, :k],
+        cache,
+        keys,
+    )
+
+
+def spec_accept_commit(props, d_probs, t_logits, temps, keys):
+    """Per-slot acceptance + correction for one speculative round ->
+    ``(commit_tokens [B, k+1], n_commit [B], keys)``; the committed
+    tokens for a slot are ``commit_tokens[i, :n_commit[i]]``.
+
+    Greedy rows (``temps <= 0``): the classic exact rule — leading
+    proposals matching the target's argmax commit, then the target's
+    corrected/bonus token (bit-lossless vs sequential greedy decode in
+    exact arithmetic).
+
+    Stochastic rows: speculative SAMPLING (Leviathan et al. 2023) —
+    proposal ``x_i`` accepts with prob ``min(1, p_t(x_i)/p_d(x_i))``;
+    at the first rejection the corrected token resamples from the
+    normalized residual ``max(p_t - p_d, 0)``; full acceptance samples
+    the bonus from ``p_t`` at the last position. The committed stream
+    is distributed EXACTLY as sequential temperature sampling from the
+    target alone — pinned against a numpy reference and a Monte-Carlo
+    marginal check in tests/test_speculative_sampling.py."""
+    b, k = props.shape
+    stoch = temps > 0
+    safe_t = jnp.maximum(temps, 1e-6)[:, None, None]
+    t_probs = jax.nn.softmax(t_logits / safe_t, axis=-1)  # [B, k+1, V]
+    greedy_choices = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    g_match = (props == greedy_choices[:, :k]).astype(jnp.int32)
+    g_acc = jnp.sum(jnp.cumprod(g_match, axis=1), axis=1)
+    p_t_prop = jnp.take_along_axis(
+        t_probs[:, :k], props[..., None], axis=-1
+    )[..., 0]
+    p_d_prop = jnp.take_along_axis(d_probs, props[..., None], axis=-1)[..., 0]
+    split = jax.vmap(jax.random.split)(keys)
+    keys, sub_u = split[:, 0], split[:, 1]
+    u = jax.vmap(lambda s: jax.random.uniform(s, (k,)))(sub_u)
+    ok = (u * jnp.maximum(p_d_prop, 1e-30) < p_t_prop).astype(jnp.int32)
+    s_acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+    n_acc = jnp.where(stoch, s_acc, g_acc)  # [B] in 0..k
+    # correction distribution at the rejection position (or bonus at k)
+    t_at = jnp.take_along_axis(
+        t_probs, n_acc[:, None, None], axis=1
+    )[:, 0]  # [B, V] — t_probs has k+1 positions, n_acc <= k is valid
+    d_at = jnp.take_along_axis(
+        d_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1
+    )[:, 0]
+    residual = jnp.maximum(t_at - d_at, 0.0)
+    rsum = jnp.sum(residual, axis=-1, keepdims=True)
+    # identical-distribution rejection is probability-0; the numeric
+    # guard falls back to p_t, which is the same limit
+    corr_dist = jnp.where(
+        (n_acc < k)[:, None] & (rsum[:, 0] > 1e-9)[:, None],
+        residual / jnp.maximum(rsum, 1e-30),
+        t_at,
+    )
+    split = jax.vmap(jax.random.split)(keys)
+    keys, sub_c = split[:, 0], split[:, 1]
+    sampled_corr = jax.vmap(
+        lambda s, p: jax.random.categorical(
+            s, jnp.log(jnp.maximum(p, 1e-30))
+        )
+    )(sub_c, corr_dist).astype(jnp.int32)
+    greedy_corr = jnp.take_along_axis(
+        greedy_choices, n_acc[:, None], axis=1
+    )[:, 0]
+    corr = jnp.where(stoch, sampled_corr, greedy_corr)
+    padded = jnp.concatenate(
+        [props, jnp.zeros((b, 1), props.dtype)], axis=1
+    )
+    commit = jnp.where(
+        jnp.arange(k + 1)[None] == n_acc[:, None], corr[:, None], padded
+    )
+    return commit, n_acc + 1, keys
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def _verify(params, cache, block, positions, cfg):
     """Target scores the whole block -> (greedy choices [B, K], cache)."""
