@@ -68,32 +68,15 @@ def sample_logits(key, logits, temperature, top_k=0, top_p=1.0):
     pure jnp so it runs inside the jitted decode chunk (vmapped per slot)
     and host-side for the prefill's first token.
 
-    ``temperature <= 0`` is greedy (k/p ignored). ``top_k == 0`` and
-    ``top_p >= 1`` disable their filters. Dynamic per-slot k/p: filters
-    are computed by sorting rather than lax.top_k so k need not be a
-    static constant."""
-    logits = logits.astype(jnp.float32)
-    temperature = jnp.asarray(temperature, jnp.float32)
-    top_k = jnp.asarray(top_k, jnp.int32)
-    top_p = jnp.asarray(top_p, jnp.float32)
-    vocab = logits.shape[-1]
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    sorted_desc = jnp.sort(scaled)[::-1]
-    # top-k: keep logits >= the k-th largest (k=0 -> keep all)
-    kth = sorted_desc[jnp.clip(top_k - 1, 0, vocab - 1)]
-    keep_k = jnp.where(top_k > 0, scaled >= kth, True)
-    # top-p: keep tokens whose mass-before-them (sorted desc) is < top_p —
-    # the shifted-cumsum form always keeps >= 1 token and is immune to
-    # float32 cumsum never quite reaching top_p on a large vocab
-    probs_desc = jax.nn.softmax(sorted_desc)
-    shifted = jnp.cumsum(probs_desc) - probs_desc
-    count = jnp.sum(shifted < top_p)
-    p_threshold = sorted_desc[jnp.clip(count - 1, 0, vocab - 1)]
-    keep_p = jnp.where(top_p < 1.0, scaled >= p_threshold, True)
-    filtered = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    ``temperature <= 0`` is greedy (k/p ignored). The filter semantics
+    live in ``speculative.filter_scaled_logits`` (shared with the
+    speculative-sampling target distribution)."""
+    from .speculative import filter_scaled_logits
+
+    filtered = filter_scaled_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature > 0, sampled, greedy)
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(temperature, jnp.float32) > 0, sampled, greedy)
 
 
 @dataclass
@@ -542,7 +525,8 @@ class InferenceEngine:
 
             def spec_round(
                 t_params, d_params, pool, d_cache, tables,
-                cur, pos0_d, pos0_v, keys, temps,
+                cur, pos0_d, pos0_v, keys, temps, top_ks, top_ps,
+                use_filters,
             ):
                 """One fused speculative round over the full slot batch:
                 draft-propose k tokens (dense per-slot cache, scan;
@@ -569,7 +553,8 @@ class InferenceEngine:
                     t_params, pool, tables, block, positions, cfg, tp=self._tp
                 )
                 commit, n_commit, keys = spec_accept_commit(
-                    props, d_probs, logits, temps, keys
+                    props, d_probs, logits, temps, keys, top_ks, top_ps,
+                    use_filters=use_filters,
                 )
                 return pool, d_cache, commit, n_commit, keys
 
@@ -581,7 +566,8 @@ class InferenceEngine:
 
             def spec_multi(
                 t_params, d_params, pool, d_cache, tables,
-                cur, pos0_d, pos0_v, keys, temps, active,
+                cur, pos0_d, pos0_v, keys, temps, top_ks, top_ps, active,
+                use_filters,
             ):
                 """``depth`` chained rounds in one dispatch: the commit
                 decision (greedy match or Leviathan acceptance) runs
@@ -598,7 +584,8 @@ class InferenceEngine:
                     pool, d_cache, cur, pos_d, pos_v, keys = carry
                     pool, d_cache, commit, n_commit, keys = spec_round(
                         t_params, d_params, pool, d_cache, tables,
-                        cur, pos_d, pos_v, keys, temps,
+                        cur, pos_d, pos_v, keys, temps, top_ks, top_ps,
+                        use_filters,
                     )
                     # the correction/bonus token (last committed) seeds
                     # the next round
@@ -623,7 +610,16 @@ class InferenceEngine:
                 )
                 return pool, d_cache, keys, commit_r, n_r
 
-            self._spec_round_jit = jax.jit(spec_multi, donate_argnums=(2, 3))
+            # one compile per filters-on/off, like the decode chunks —
+            # greedy/plain-temperature batches never pay the per-row
+            # vocab sort the top-k/top-p target distribution needs
+            self._spec_round_jit = {
+                filt: jax.jit(
+                    _partial(spec_multi, use_filters=filt),
+                    donate_argnums=(2, 3),
+                )
+                for filt in (False, True)
+            }
 
             def draft_prefill(d_params, d_cache, tokens, slot_idx):
                 # one full-sequence draft forward (big MXU matmuls) seeds
@@ -823,9 +819,9 @@ class InferenceEngine:
                     jnp.asarray(0, jnp.int32),
                 )
                 timings[f"draft_prefill_{c}"] = round(time.monotonic() - t0, 3)
-            t0 = time.monotonic()
-            self.pool, self._draft_cache, self._keys, _, _ = (
-                self._spec_round_jit(
+            for filt, fn in self._spec_round_jit.items():
+                t0 = time.monotonic()
+                self.pool, self._draft_cache, self._keys, _, _ = fn(
                     self.params,
                     self.draft_params,
                     self.pool,
@@ -836,10 +832,13 @@ class InferenceEngine:
                     zb,
                     self._keys,
                     jnp.zeros((B,), jnp.float32),
+                    zb,
+                    jnp.ones((B,), jnp.float32),
                     jnp.zeros((B,), bool),  # all parked
                 )
-            )
-            timings["spec_round"] = round(time.monotonic() - t0, 3)
+                timings[
+                    f"spec_round{'_filters' if filt else ''}"
+                ] = round(time.monotonic() - t0, 3)
         jax.block_until_ready(self.pool)
         return timings
 
@@ -1238,13 +1237,13 @@ class InferenceEngine:
             first = sample_logits(
                 sub, lg, req.temperature, req.top_k, req.top_p
             )
-            if self.draft_params is not None and (
-                req.temperature <= 0
-                or (req.top_k == 0 and req.top_p >= 1.0)
-            ):
-                # greedy OR plain temperature sampling can ride the
-                # speculative path (filtered sampling cannot — see the
-                # eligibility comment in _loop)
+            if self.draft_params is not None and not req.logit_bias:
+                # every sampling config can ride the speculative path
+                # (greedy matching, or Leviathan accept/resample against
+                # the filtered target distribution). logit_bias slots
+                # are spec-ineligible for their whole lifetime, so their
+                # draft prefill would be dead work; min_new_tokens slots
+                # become eligible later, so theirs pays off
                 self._draft_prefill(slot_idx)
             slot.ready = True
             self._emit(slot_idx, int(first))
@@ -1450,20 +1449,11 @@ class InferenceEngine:
                 spec_idx = [
                     i
                     for i in ready
-                    # greedy, or PLAIN temperature sampling (speculative
-                    # sampling accepts/resamples against the target's
-                    # temperature distribution — lossless in
-                    # distribution); top-k/top-p filters reshape p_t in
-                    # ways the accept rule doesn't model, so filtered
-                    # slots take the plain path
-                    if (
-                        self.slots[i].req.temperature <= 0
-                        or (
-                            self.slots[i].req.top_k == 0
-                            and self.slots[i].req.top_p >= 1.0
-                        )
-                    )
-                    and self.slots[i].draft_ready
+                    # greedy AND sampling (incl. top-k/top-p: the
+                    # accept/resample rule runs against the FILTERED
+                    # target distribution — lossless in distribution
+                    # for any proposal distribution)
+                    if self.slots[i].draft_ready
                     and self.slots[i].length + spec_span - 1 <= self.max_len
                     # the spec round samples without the per-slot extras:
                     # biased slots would commit unbiased tokens, and
@@ -1656,6 +1646,28 @@ class InferenceEngine:
             ],
             jnp.float32,
         )
+        top_ks = jnp.asarray(
+            [
+                (s.req.top_k if i in spec_set else 0)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.int32,
+        )
+        top_ps = jnp.asarray(
+            [
+                (s.req.top_p if i in spec_set else 1.0)
+                for i, s in enumerate(self.slots)
+            ],
+            jnp.float32,
+        )
+        filters_on = any(
+            self.slots[i].req.temperature > 0
+            and (
+                self.slots[i].req.top_k > 0
+                or self.slots[i].req.top_p < 1.0
+            )
+            for i in spec_idx
+        )
         try:
             (
                 self.pool,
@@ -1663,7 +1675,7 @@ class InferenceEngine:
                 self._keys,
                 commit,
                 n_commit,
-            ) = self._spec_round_jit(
+            ) = self._spec_round_jit[filters_on](
                 self.params,
                 self.draft_params,
                 self.pool,
@@ -1674,6 +1686,8 @@ class InferenceEngine:
                 pos0_verify,
                 self._keys,
                 temps,
+                top_ks,
+                top_ps,
                 jnp.asarray(
                     [i in spec_set for i in range(self.max_slots)]
                 ),

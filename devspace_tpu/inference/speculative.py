@@ -75,6 +75,37 @@ def _draft_propose(params, cache, cur, pos0, cfg, k):
     return jnp.moveaxis(props, 0, 1)[:, :k], cache  # [B, k]
 
 
+def filter_scaled_logits(logits, temperature, top_k=0, top_p=1.0):
+    """Temperature-scale one logit row and mask it to the top-k/top-p
+    keep set (-inf outside) — THE single implementation of the filter
+    semantics: ``engine.sample_logits`` and the speculative-sampling
+    target distribution must stay in lockstep or filtered requests
+    would sample and verify against different distributions.
+
+    ``top_k == 0`` and ``top_p >= 1`` disable their filters. Dynamic
+    per-slot k/p: filters are computed by sorting rather than
+    ``lax.top_k`` so k need not be a static constant."""
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sorted_desc = jnp.sort(scaled)[::-1]
+    # top-k: keep logits >= the k-th largest (k=0 -> keep all)
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, vocab - 1)]
+    keep_k = jnp.where(top_k > 0, scaled >= kth, True)
+    # top-p: keep tokens whose mass-before-them (sorted desc) is < top_p —
+    # the shifted-cumsum form always keeps >= 1 token and is immune to
+    # float32 cumsum never quite reaching top_p on a large vocab
+    probs_desc = jax.nn.softmax(sorted_desc)
+    shifted = jnp.cumsum(probs_desc) - probs_desc
+    count = jnp.sum(shifted < top_p)
+    p_threshold = sorted_desc[jnp.clip(count - 1, 0, vocab - 1)]
+    keep_p = jnp.where(top_p < 1.0, scaled >= p_threshold, True)
+    return jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+
+
 @partial(jax.jit, static_argnames=("cfg", "k"))
 def _draft_propose_sampled(params, cache, cur, pos0, cfg, k, keys, temps):
     """Propose k tokens per sequence, SAMPLING rows with temps > 0
@@ -115,7 +146,10 @@ def _draft_propose_sampled(params, cache, cur, pos0, cfg, k, keys, temps):
     )
 
 
-def spec_accept_commit(props, d_probs, t_logits, temps, keys):
+def spec_accept_commit(
+    props, d_probs, t_logits, temps, keys, top_ks=None, top_ps=None,
+    use_filters=True,
+):
     """Per-slot acceptance + correction for one speculative round ->
     ``(commit_tokens [B, k+1], n_commit [B], keys)``; the committed
     tokens for a slot are ``commit_tokens[i, :n_commit[i]]``.
@@ -132,11 +166,35 @@ def spec_accept_commit(props, d_probs, t_logits, temps, keys):
     the bonus from ``p_t`` at the last position. The committed stream
     is distributed EXACTLY as sequential temperature sampling from the
     target alone — pinned against a numpy reference and a Monte-Carlo
-    marginal check in tests/test_speculative_sampling.py."""
+    marginal check in tests/test_speculative_sampling.py.
+
+    ``top_ks``/``top_ps`` (per-slot, optional) make the target
+    distribution the FILTERED one (:func:`filter_scaled_logits` — the
+    same filter the plain path samples with): the Leviathan rule is
+    valid for any proposal distribution, so the draft still proposes
+    from its unfiltered temperature distribution and out-of-filter
+    proposals simply auto-reject (p_t = 0). ``use_filters=False``
+    (compile-time) skips the per-row vocab sort entirely — the caller
+    compiles one variant per case, like the engine's decode chunks, so
+    greedy/plain-temperature batches never pay for filters they don't
+    use."""
     b, k = props.shape
     stoch = temps > 0
-    safe_t = jnp.maximum(temps, 1e-6)[:, None, None]
-    t_probs = jax.nn.softmax(t_logits / safe_t, axis=-1)  # [B, k+1, V]
+    if use_filters:
+        if top_ks is None:
+            top_ks = jnp.zeros((b,), jnp.int32)
+        if top_ps is None:
+            top_ps = jnp.ones((b,), jnp.float32)
+        filtered = jax.vmap(  # over slots ...
+            lambda rows, t, tk, tp: jax.vmap(  # ... then block positions
+                lambda row: filter_scaled_logits(row, t, tk, tp)
+            )(rows)
+        )(t_logits, temps, top_ks, top_ps)
+    else:
+        filtered = t_logits.astype(jnp.float32) / jnp.maximum(
+            temps, 1e-6
+        )[:, None, None]
+    t_probs = jax.nn.softmax(filtered, axis=-1)  # [B, k+1, V]
     greedy_choices = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
     g_match = (props == greedy_choices[:, :k]).astype(jnp.int32)
     g_acc = jnp.sum(jnp.cumprod(g_match, axis=1), axis=1)

@@ -1292,7 +1292,7 @@ def test_prewarm_no_new_compiles(params):
     fns = [
         engine._prefill_step_jit,
         engine._draft_prefill_jit,
-        engine._spec_round_jit,
+        *engine._spec_round_jit.values(),
         *engine._decode_chunk.values(),
     ]
     before = [f._cache_size() for f in fns]

@@ -112,8 +112,7 @@ def params():
 def test_engine_temperature_rides_speculative_path(params):
     """A plain-temperature request must be spec-eligible (draft prefill
     + spec rounds run), produce the right token count in-vocab, and be
-    reproducible for the same seed; a top-k request must stay on the
-    plain path."""
+    reproducible for the same seed; a top-k request rides spec too."""
     engine = InferenceEngine(
         params, CFG, max_slots=2, max_len=64,
         draft_params=params, draft_cfg=CFG, spec_k=3, spec_depth=2,
@@ -132,10 +131,9 @@ def test_engine_temperature_rides_speculative_path(params):
     assert rounds_after_temp > 0, "temperature request must ride spec"
     assert len(toks) == 14
     assert all(0 <= t < CFG.vocab_size for t in toks)
-    # filtered sampling is ineligible: rounds counter advanced at most
-    # by idle-slot dispatches of the OTHER path (none here: no greedy
-    # peer was resident), so it must not have grown
-    assert rounds_after_topk == rounds_after_temp
+    # filtered sampling rides the spec path too (the accept rule runs
+    # against the filtered target distribution)
+    assert rounds_after_topk > rounds_after_temp
 
     # same seed, fresh engine, deterministic scheduling (single request)
     # -> identical stream
@@ -172,3 +170,42 @@ def test_engine_greedy_unchanged_with_stochastic_neighbor(params):
         engine.stop()
     assert greedy == [int(t) for t in ref[0]]
     assert len(temp) == 8
+
+
+def test_stochastic_filtered_marginal_matches_filtered_target():
+    """top-k filtered speculative sampling: the first committed token's
+    marginal must equal the RENORMALIZED top-k target distribution (the
+    same distribution the plain path samples), with out-of-filter draft
+    proposals auto-rejecting."""
+    rng = np.random.default_rng(3)
+    V, k, N, TOPK = 8, 1, 40_000, 3
+    p_t = rng.dirichlet(np.ones(V) * 0.7)
+    p_d = rng.dirichlet(np.ones(V) * 0.7)
+    t_logits_row = np.log(p_t)
+    keep = np.argsort(t_logits_row)[::-1][:TOPK]
+    p_t_filt = np.zeros(V)
+    p_t_filt[keep] = p_t[keep] / p_t[keep].sum()
+    t_logits = jnp.asarray(t_logits_row, jnp.float32)[None, None, :].repeat(
+        N, 0
+    ).repeat(k + 1, 1)
+    d_probs = jnp.asarray(p_d, jnp.float32)[None, None, :].repeat(N, 0)
+    pk = jax.vmap(jax.random.PRNGKey)(jnp.arange(N))
+    props = jax.vmap(
+        lambda s: jax.random.categorical(s, jnp.log(d_probs[0, 0]))
+    )(pk)[:, None].astype(jnp.int32)
+    commit, n_commit, _ = spec_accept_commit(
+        props, d_probs, t_logits, jnp.ones((N,), jnp.float32),
+        _keys(N, seed=900_000),
+        top_ks=jnp.full((N,), TOPK, jnp.int32),
+        top_ps=jnp.ones((N,), jnp.float32),
+    )
+    first = np.asarray(commit)[:, 0]
+    emp = np.bincount(first, minlength=V) / N
+    tv = 0.5 * np.abs(emp - p_t_filt).sum()
+    assert tv < 0.02, f"filtered marginal TV {tv:.4f}"
+    # out-of-filter tokens never commit
+    assert emp[[i for i in range(V) if i not in set(keep)]].sum() == 0
+    # acceptance = sum_x min(p_t_filt, p_d)
+    acc = float((np.asarray(n_commit) - 1).mean())
+    want = float(np.minimum(p_t_filt, p_d).sum())
+    assert abs(acc - want) < 0.02, (acc, want)
