@@ -683,6 +683,22 @@ def run_lm_isolated(notes: list[str], resnet_platform: str) -> tuple[float, floa
     return result or (0.0, 0.0, "none")
 
 
+def bench_prefix_cache() -> tuple[float, float]:
+    """Radix prefix-cache host costs (devspace_tpu/inference/
+    prefix_cache.py): mean microseconds to match a fully-cached 4k-token
+    prompt and to evict one victim chain from a 10k-entry cache. The
+    >=10x-vs-flat-map acceptance ratio is pinned separately in
+    tests/test_prefix_cache.py; here we track the absolute numbers
+    across rounds (BENCH_*.json ``prefix_match_us``/``prefix_evict_us``)."""
+    from devspace_tpu.inference.prefix_cache import microbench
+
+    mb = microbench(
+        n_entries=10_000, prompt_tokens=4096, block_size=64,
+        n_match=30, n_evict=50,
+    )
+    return mb["radix"]["match_us"], mb["radix"]["evict_us"]
+
+
 def main() -> int:
     if os.environ.get("DEVSPACE_BENCH_WEDGE_CHILD") and (
         "--resnet-child" in sys.argv or "--lm-child" in sys.argv
@@ -705,6 +721,20 @@ def main() -> int:
         scan_stale_processes()
     except Exception as e:  # noqa: BLE001
         log(f"[bench] stale-process scan failed: {e}")
+    # host-side prefix-cache microbenchmark (ISSUE 1): scheduler-thread
+    # cost of a radix-cache match and evict on a 10k-entry cache with
+    # 4k-token prompts — pure Python, no accelerator, seconds of wall
+    # time, so it runs unconditionally and never touches the budget legs
+    prefix_match_us = prefix_evict_us = None
+    try:
+        prefix_match_us, prefix_evict_us = bench_prefix_cache()
+        log(
+            f"[bench] prefix cache (10k entries, 4k-token prompts): "
+            f"match {prefix_match_us}us, evict {prefix_evict_us}us"
+        )
+    except Exception as e:  # noqa: BLE001
+        notes.append(f"prefix-cache bench failed: {e}")
+        log(f"[bench] prefix-cache bench failed: {e}")
     sync_latency = None
     try:
         sync_latency = bench_sync_latency()
@@ -807,6 +837,9 @@ def main() -> int:
         if initial_sync_s
         else None,
         "dev_loop_cold_s": round(dev_s, 2) if dev_s else None,
+        # host-side radix prefix-cache costs (10k entries, 4k prompts)
+        "prefix_match_us": prefix_match_us,
+        "prefix_evict_us": prefix_evict_us,
     }
     hb(f"bench done (status={status})")
     print(json.dumps(result))

@@ -213,7 +213,13 @@ def _check_hpa_slice_conflict(manifests: list[dict]) -> None:
         )
         spec = doc.get("spec") or {}
         tmpl = ((spec.get("template") or {}).get("spec")) or {}
-        for c in tmpl.get("containers") or []:
+        # initContainers too: a workload wiring the roster through an
+        # init container (e.g. one that writes it for the main process)
+        # is the same multi-host slice and must not evade the hard error
+        containers = list(tmpl.get("containers") or []) + list(
+            tmpl.get("initContainers") or []
+        )
+        for c in containers:
             for e in c.get("env") or []:
                 if (
                     isinstance(e, dict)

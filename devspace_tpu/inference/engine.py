@@ -52,7 +52,6 @@ import math
 import queue
 import threading
 import time
-from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -61,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer as tfm
+from .prefix_cache import RadixPrefixCache
 
 
 def sample_logits(key, logits, temperature, top_k=0, top_p=1.0):
@@ -352,6 +352,10 @@ class InferenceEngine:
         self.draft_cfg = draft_cfg
         self.spec_k = int(spec_k)
         self.spec_depth = int(spec_depth)
+        # spec counters all measure REPLAYED slot-rounds (rounds the
+        # host commit loop actually consumed): rounds/proposed/accepted
+        # stay mutually consistent, and device rounds discarded when a
+        # slot finishes mid-dispatch never skew committed-per-round
         self.spec_rounds = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
@@ -393,15 +397,16 @@ class InferenceEngine:
         self._tables = np.zeros((max_slots, self.max_blocks), np.int32)
         self._nalloc = [0] * max_slots  # allocated blocks per slot
         # prefix cache (vLLM-style): full PROMPT blocks, once their K/V
-        # is written, are published under their exact token-prefix key;
-        # later admissions sharing the prefix point their tables at the
-        # SAME pool blocks (refcounted) and skip recomputing them. Keys
-        # are the literal token tuples — no hash-collision risk, host
-        # memory is a few KB per cached block at serving scale.
+        # is written, are published in a radix tree over token blocks
+        # (inference/prefix_cache.py); later admissions sharing the
+        # prefix point their tables at the SAME pool blocks (refcounted)
+        # and skip recomputing them. Edges are literal token tuples — no
+        # hash-collision risk, host memory is a few KB per cached block
+        # at serving scale; match cost is O(prompt) and eviction cost is
+        # O(evicted chain), never O(whole cache).
         self.prefix_cache_enabled = bool(prefix_cache)
         self._prewarm_on_start = bool(prewarm)
-        self._prefix_map: "OrderedDict[tuple, int]" = OrderedDict()
-        self._published: dict[int, tuple] = {}  # blk -> its key
+        self._prefix_cache = RadixPrefixCache()
         self._block_refs: dict[int, int] = {}  # blk -> table references
         self.prefix_hit_blocks = 0
         self.slots = [_Slot() for _ in range(max_slots)]
@@ -862,7 +867,7 @@ class InferenceEngine:
             "max_slots": self.max_slots,
             "free_blocks": len(self._free_blocks),
             "total_blocks": self.n_blocks - 1,
-            "prefix_cached_blocks": len(self._published),
+            "prefix_cached_blocks": len(self._prefix_cache),
             "prefix_hit_blocks": self.prefix_hit_blocks,
             "queued": self.pending.qsize() + len(self._resume),
             "uptime_s": round(uptime, 1),
@@ -897,37 +902,22 @@ class InferenceEngine:
 
     def _evictable(self) -> int:
         """Published cache blocks no table references — reclaimable."""
-        return sum(
-            1 for b in self._published if self._block_refs.get(b, 0) == 0
-        )
+        return self._prefix_cache.evictable()
 
     def _pop_block(self) -> int:
         """Take a block for private use: free list first, then evict the
         least-recently-matched ref-0 cache entry. Caller must have
-        checked availability (free + evictable)."""
+        checked availability (free + evictable). Every cached prefix
+        extending the evicted block is unmatchable (_match_prefix needs
+        the full ancestor chain), so the cache unpublishes the victim's
+        subtree with it — ref-0 descendants return to the free list NOW,
+        in-use ones are unpublished so their release frees them. Cost is
+        proportional to the evicted chain (radix tree), never to the
+        whole cache."""
         if self._free_blocks:
             return self._free_blocks.pop()
-        victim = None
-        for key, blk in self._prefix_map.items():  # LRU order: oldest first
-            if self._block_refs.get(blk, 0) == 0:
-                victim = (key, blk)
-                break
-        if victim is None:
-            raise RuntimeError("allocator invariant: no block available")
-        key, blk = victim
-        del self._prefix_map[key]
-        del self._published[blk]
-        # every cached prefix extending the evicted key is now
-        # unmatchable (_match_prefix needs the full ancestor chain), so
-        # reclaim ref-0 descendants to the free list NOW and unpublish
-        # in-use ones so their release frees them — instead of dead
-        # cache blocks occupying pool space one _pop_block at a time
-        n = len(key)
-        for k2 in [k for k in self._prefix_map if len(k) > n and k[:n] == key]:
-            b2 = self._prefix_map.pop(k2)
-            del self._published[b2]
-            if self._block_refs.get(b2, 0) == 0:
-                self._free_blocks.append(b2)
+        blk, freed = self._prefix_cache.pop_victim()
+        self._free_blocks.extend(freed)
         return blk
 
     def _alloc(self, slot_idx: int, upto: int) -> bool:
@@ -948,27 +938,31 @@ class InferenceEngine:
         for b in (int(b) for b in self._tables[slot_idx, :n]):
             refs = self._block_refs.get(b, 1) - 1
             self._block_refs[b] = refs
-            if refs <= 0 and b not in self._published:
+            if self._prefix_cache.is_published(b):
+                # published ref-0 blocks stay resident as prefix cache
+                # until the allocator needs them (_pop_block eviction);
+                # the cache mirrors the table refcount to know which
+                self._prefix_cache.release(b)
+            elif refs <= 0:
                 self._free_blocks.append(b)
-            # published ref-0 blocks stay resident as prefix cache until
-            # the allocator needs them (_pop_block eviction)
         self._tables[slot_idx, :] = 0
         self._nalloc[slot_idx] = 0
 
     def _match_prefix(self, prompt: list) -> list:
         """Longest run of already-cached full prompt blocks, capped so at
         least ONE prompt token is left to prefill (its logits seed the
-        first generated token)."""
+        first generated token). One radix-tree step per block: O(block)
+        hashing per step, O(prompt) total — never re-tupling the whole
+        prefix."""
         if not self.prefix_cache_enabled:
             return []
         matched = []
         bs = self.block_size
+        cur = self._prefix_cache.cursor()
         for i in range((len(prompt) - 1) // bs):
-            key = tuple(prompt[: (i + 1) * bs])
-            blk = self._prefix_map.get(key)
+            blk = cur.step(tuple(prompt[i * bs : (i + 1) * bs]))
             if blk is None:
                 break
-            self._prefix_map.move_to_end(key)  # LRU touch
             matched.append(blk)
         return matched
 
@@ -977,21 +971,21 @@ class InferenceEngine:
         Called after each prefill chunk; a block is publishable once
         prefill has passed its end (its K/V is final: later writes are
         all at higher positions). First writer wins — a concurrently
-        computed duplicate stays private."""
+        computed duplicate stays private (the cursor descends through
+        the first writer's node and our block is simply not inserted)."""
         if not self.prefix_cache_enabled:
             return
         slot = self.slots[slot_idx]
         bs = self.block_size
         n_full = min(slot.prefill_pos, len(slot.prompt)) // bs
+        cur = self._prefix_cache.cursor()
         for i in range(n_full):
             blk = int(self._tables[slot_idx, i])
-            if blk in self._published:
-                continue  # already matchable (e.g. matched at admission)
-            key = tuple(slot.prompt[: (i + 1) * bs])
-            if key in self._prefix_map:
-                continue  # another block already holds this content
-            self._prefix_map[key] = blk
-            self._published[blk] = key
+            cur.publish(
+                tuple(slot.prompt[i * bs : (i + 1) * bs]),
+                blk,
+                self._block_refs.get(blk, 0),
+            )
 
     def _decode_tables(self, include=None) -> jax.Array:
         """Block tables for a dispatch: slots outside ``include`` (default:
@@ -1064,8 +1058,7 @@ class InferenceEngine:
         self._free_blocks = list(range(1, self.n_blocks))
         self._tables[:] = 0
         self._nalloc = [0] * self.max_slots
-        self._prefix_map.clear()
-        self._published.clear()
+        self._prefix_cache.reset()
         self._block_refs.clear()
 
     def _bucket(self, n: int) -> int:
@@ -1079,7 +1072,14 @@ class InferenceEngine:
         """Power-of-two sizes up to ``limit`` (plus ``limit`` itself when
         ``include_limit`` and it is not one) — THE bucket enumeration the
         shape-keyed dispatch paths and prewarm() share; the
-        no-new-compiles guarantee holds only while they agree."""
+        no-new-compiles guarantee holds only while they agree.
+
+        ``limit`` must be >= 1: the contract is every returned size is
+        <= limit, and for limit < 1 there is no such bucket — returning
+        [1] anyway (the old behavior) would hand callers an overshooting
+        chunk shape (ADVICE r5)."""
+        if limit < 1:
+            raise ValueError(f"_pow2_buckets needs limit >= 1, got {limit}")
         out = [1]
         while out[-1] * 2 <= limit:
             out.append(out[-1] * 2)
@@ -1112,18 +1112,16 @@ class InferenceEngine:
         # availability must not count the matched blocks themselves: a
         # ref-0 cached block we are about to reference is no longer
         # evictable for the private-block pops
-        matched_set = set(matched)
-        avail = len(self._free_blocks) + sum(
-            1
-            for b in self._published
-            if self._block_refs.get(b, 0) == 0 and b not in matched_set
-        )
+        avail = len(
+            self._free_blocks
+        ) + self._prefix_cache.evictable_excluding(matched)
         if need > avail:
             return False
         # commit: reference matched blocks FIRST so the private-block
         # pops below can never evict them
         for i, blk in enumerate(matched):
             self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+            self._prefix_cache.ref(blk)
             self._tables[slot_idx, i] = blk
         self._nalloc[slot_idx] = len(matched)
         ok = self._alloc(slot_idx, len(prompt))
@@ -1199,6 +1197,12 @@ class InferenceEngine:
         # chunk would be a shape no one compiled (prewarm() enumerates
         # the bucket set and promises no mid-serving compiles).
         t_alloc = self.max_blocks * self.block_size
+        # the slot's allocation always covers past the prefill offset
+        # (admission allocated the whole prompt); _pow2_buckets would
+        # raise for a non-positive span, so make the invariant explicit
+        assert t_alloc > offset, (
+            f"prefill offset {offset} outside allocated span {t_alloc}"
+        )
         if c > t_alloc - offset:
             c = self._pow2_buckets(t_alloc - offset, include_limit=False)[-1]
         real = min(remaining, c)
@@ -1702,7 +1706,6 @@ class InferenceEngine:
             self._reset_pool()
             self._reset_draft_cache()
             return
-        self.spec_rounds += self.spec_depth
         k = self.spec_k
         for i in spec_idx:
             for r in range(self.spec_depth):
@@ -1711,10 +1714,15 @@ class InferenceEngine:
                     # later rounds for this slot are discarded speculation
                     break
                 n = int(n_commit[r, i])
+                # rounds/accepted/proposed all count REPLAYED slot-rounds
+                # (ADVICE r5: counting dispatched device rounds skewed
+                # committed_per_round low near end-of-generation — the
+                # discarded tail rounds proposed nothing the host kept).
                 # accepted/proposed measure the DRAFT-MATCH rate (the
                 # number the operator tunes draft choice and SPEC_K by) —
                 # raw n-1, not capped by how many tokens the request had
                 # room to commit; spec_committed counts actual emits
+                self.spec_rounds += 1
                 self.spec_proposed += k
                 self.spec_accepted += n - 1
                 committed = 0

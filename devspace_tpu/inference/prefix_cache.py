@@ -1,0 +1,385 @@
+"""Radix-tree prefix cache for the paged-KV inference engine.
+
+The engine's prefix cache used to be a flat ``OrderedDict`` keyed by
+FULL token-prefix tuples. Correct, but two hot host-side paths scaled
+with the whole cache instead of the work at hand (ADVICE r5):
+
+- **match**: rebuilding and hashing a length-``i*block`` tuple for every
+  matched block is O(L^2/block) hashing per admission on a long prompt;
+- **evict**: descendant invalidation compared ``k2[:n] == key`` against
+  EVERY cached key — O(cached_keys x key_length) on the scheduler
+  thread per eviction.
+
+This module replaces the flat map with a radix tree over token BLOCKS
+(RadixAttention-style: SGLang / vLLM prefix sharing). Each node's edge
+is one block's token tuple, so:
+
+- **match is O(prompt)**: a cursor walks the tree one block at a time,
+  hashing exactly ``block_size`` tokens per step (`Cursor.step`);
+- **evict is O(evicted chain)**: parent->children links make descendant
+  invalidation a walk of the evicted subtree, and the victim search is
+  a lazy min-heap over evictable candidates instead of a scan of every
+  key (`pop_victim`).
+
+Semantics are EXACTLY those of the flat map — pinned by a randomized
+trace-equivalence test against :class:`FlatPrefixCache` (the reference
+port of the old engine code, kept for tests and the microbenchmark):
+
+- a block is published under its content (the token chain from the
+  root); first writer wins, duplicates stay private;
+- matching touches the chain LRU-most-recent, publishing does not
+  reorder existing entries;
+- the eviction victim is the LEAST-RECENTLY-TOUCHED block with no table
+  references, exactly the old ``OrderedDict`` scan order;
+- evicting a mid-chain block unpublishes every descendant (a prefix
+  chain is only matchable through its full ancestor line): ref-0
+  descendants are freed immediately, in-use ones are unpublished so
+  their table release frees them.
+
+The cache owns no pool blocks — it maps block ids it is told about and
+mirrors the engine's table refcounts via :meth:`ref`/:meth:`release`.
+Everything here is plain host Python: no jax, no locks (the engine's
+scheduler thread is the only caller).
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+from typing import Optional
+
+
+class _Node:
+    """One published block: ``edge`` is the block's own token tuple (the
+    child key under ``parent``), ``blk`` the pool block id, ``refs`` the
+    mirrored table refcount, ``touch`` the LRU stamp (monotonic clock;
+    larger = more recently matched/published)."""
+
+    __slots__ = ("edge", "parent", "children", "blk", "refs", "touch", "live")
+
+    def __init__(self, edge, parent, blk, refs, touch):
+        self.edge = edge
+        self.parent = parent
+        self.children: dict = {}
+        self.blk = blk
+        self.refs = refs
+        self.touch = touch
+        self.live = True
+
+
+class Cursor:
+    """Incremental walk from the root, one block per step — the unit of
+    hashing is ONE block's token tuple, never the whole prefix."""
+
+    __slots__ = ("_cache", "_node")
+
+    def __init__(self, cache: "RadixPrefixCache"):
+        self._cache = cache
+        self._node = cache._root
+
+    def step(self, edge: tuple) -> Optional[int]:
+        """Match one block: descend by ``edge`` and return the resident
+        block id (touching it LRU-most-recent), or None when the chain
+        ends here. O(len(edge)) hashing."""
+        child = self._node.children.get(edge)
+        if child is None:
+            return None
+        self._cache._touch(child)
+        self._node = child
+        return child.blk
+
+    def publish(self, edge: tuple, blk: int, refs: int) -> int:
+        """Publish one block: descend by ``edge``, inserting a node for
+        ``blk`` (with ``refs`` mirrored table references) when the chain
+        ends here. Returns the RESIDENT block id — ``blk`` itself when
+        inserted, the first writer's block when the content is already
+        cached (the caller's copy stays private). Existing entries are
+        NOT LRU-touched (publish never reorders, matching the flat
+        map)."""
+        child = self._node.children.get(edge)
+        if child is not None:
+            self._node = child
+            return child.blk
+        cache = self._cache
+        cache._clock += 1
+        node = _Node(edge, self._node, blk, refs, cache._clock)
+        self._node.children[edge] = node
+        cache._by_block[blk] = node
+        if refs == 0:
+            cache._evictable += 1
+            heapq.heappush(cache._heap, (node.touch, id(node), node))
+        self._node = node
+        return blk
+
+
+class RadixPrefixCache:
+    """Tree-structured published-block index. See module docstring."""
+
+    def __init__(self):
+        self._root = _Node(None, None, -1, 0, 0)
+        self._root.live = False  # never a victim
+        self._by_block: dict[int, _Node] = {}
+        self._clock = 0
+        # lazy min-heap of (touch, tiebreak, node) eviction candidates:
+        # entries go stale when the node is re-touched, re-referenced or
+        # evicted; pop_victim discards them on the way out. Only ref-0
+        # nodes are ever pushed, so the heap never scans live traffic.
+        self._heap: list = []
+        self._evictable = 0
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def is_published(self, blk: int) -> bool:
+        return blk in self._by_block
+
+    def evictable(self) -> int:
+        """Published blocks with no table references — reclaimable. O(1)."""
+        return self._evictable
+
+    def evictable_excluding(self, blks) -> int:
+        """Evictable count, not counting ``blks`` (an admission must not
+        count the ref-0 cached blocks it is itself about to reference as
+        evictable for its private pops). O(len(blks))."""
+        n = self._evictable
+        for b in blks:
+            node = self._by_block.get(b)
+            if node is not None and node.refs == 0:
+                n -= 1
+        return n
+
+    # -- matching / publishing --------------------------------------------
+    def cursor(self) -> Cursor:
+        return Cursor(self)
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.touch = self._clock
+        if node.refs == 0:
+            heapq.heappush(self._heap, (node.touch, id(node), node))
+
+    # -- refcount mirror ---------------------------------------------------
+    def ref(self, blk: int) -> None:
+        """A slot table now references published block ``blk``."""
+        node = self._by_block[blk]
+        node.refs += 1
+        if node.refs == 1:
+            self._evictable -= 1
+
+    def release(self, blk: int) -> None:
+        """A slot table dropped its reference to published block ``blk``.
+        At ref 0 the block becomes an eviction candidate at its LAST
+        TOUCH position (matching survives the referenced span — the flat
+        map's move_to_end happened at match time, not release time)."""
+        node = self._by_block[blk]
+        node.refs -= 1
+        if node.refs == 0:
+            self._evictable += 1
+            heapq.heappush(self._heap, (node.touch, id(node), node))
+
+    # -- eviction ----------------------------------------------------------
+    def pop_victim(self) -> tuple[int, list[int]]:
+        """Reclaim the least-recently-touched ref-0 block for private
+        reuse. Returns ``(victim_blk, freed)`` where ``freed`` lists the
+        victim's ref-0 DESCENDANT blocks, unpublished along with it (the
+        chain below an evicted block is unmatchable — ``freed`` goes
+        straight back to the allocator's free list; in-use descendants
+        are unpublished so their table release frees them). Cost is the
+        heap pop plus a walk of the evicted subtree — never a scan of
+        the whole cache. Raises RuntimeError when nothing is evictable."""
+        victim = None
+        while self._heap:
+            touch, _, node = heapq.heappop(self._heap)
+            if node.live and node.refs == 0 and node.touch == touch:
+                victim = node
+                break
+        if victim is None:
+            raise RuntimeError("allocator invariant: no block available")
+        del victim.parent.children[victim.edge]
+        self._unpublish(victim)
+        freed: list[int] = []
+        stack = list(victim.children.values())
+        while stack:
+            n = stack.pop()
+            self._unpublish(n)
+            if n.refs == 0:
+                freed.append(n.blk)
+            stack.extend(n.children.values())
+        return victim.blk, freed
+
+    def _unpublish(self, node: _Node) -> None:
+        del self._by_block[node.blk]
+        node.live = False
+        if node.refs == 0:
+            self._evictable -= 1
+
+    def reset(self) -> None:
+        """Drop everything (the pool the blocks indexed is gone)."""
+        self._root = _Node(None, None, -1, 0, 0)
+        self._root.live = False
+        self._by_block.clear()
+        self._heap.clear()
+        self._evictable = 0
+
+
+class FlatPrefixCache:
+    """The OLD flat-map implementation behind the same API — a faithful
+    port of the pre-radix engine code (OrderedDict keyed by full token
+    prefixes, linear victim scan, full-key descendant sweep). Kept as
+    the REFERENCE MODEL: the randomized trace-equivalence test pins the
+    radix cache to it, and the microbenchmark measures the speedup
+    against it. Not used by the engine."""
+
+    def __init__(self):
+        self._map: "OrderedDict[tuple, int]" = OrderedDict()
+        self._published: dict[int, tuple] = {}  # blk -> its key
+        self._refs: dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._published)
+
+    def is_published(self, blk: int) -> bool:
+        return blk in self._published
+
+    def evictable(self) -> int:
+        return sum(
+            1 for b in self._published if self._refs.get(b, 0) == 0
+        )
+
+    def evictable_excluding(self, blks) -> int:
+        excl = set(blks)
+        return sum(
+            1
+            for b in self._published
+            if self._refs.get(b, 0) == 0 and b not in excl
+        )
+
+    def cursor(self) -> "_FlatCursor":
+        return _FlatCursor(self)
+
+    def ref(self, blk: int) -> None:
+        self._refs[blk] = self._refs.get(blk, 0) + 1
+
+    def release(self, blk: int) -> None:
+        self._refs[blk] = self._refs.get(blk, 0) - 1
+
+    def pop_victim(self) -> tuple[int, list[int]]:
+        victim = None
+        for key, blk in self._map.items():  # LRU order: oldest first
+            if self._refs.get(blk, 0) == 0:
+                victim = (key, blk)
+                break
+        if victim is None:
+            raise RuntimeError("allocator invariant: no block available")
+        key, blk = victim
+        del self._map[key]
+        del self._published[blk]
+        freed: list[int] = []
+        n = len(key)
+        for k2 in [k for k in self._map if len(k) > n and k[:n] == key]:
+            b2 = self._map.pop(k2)
+            del self._published[b2]
+            if self._refs.get(b2, 0) == 0:
+                freed.append(b2)
+        return blk, freed
+
+    def reset(self) -> None:
+        self._map.clear()
+        self._published.clear()
+        self._refs.clear()
+
+
+class _FlatCursor:
+    """Full-prefix rehash per step — the O(L^2) shape being replaced."""
+
+    __slots__ = ("_cache", "_prefix")
+
+    def __init__(self, cache: FlatPrefixCache):
+        self._cache = cache
+        self._prefix: list = []
+
+    def step(self, edge: tuple) -> Optional[int]:
+        self._prefix.extend(edge)
+        key = tuple(self._prefix)
+        blk = self._cache._map.get(key)
+        if blk is None:
+            return None
+        self._cache._map.move_to_end(key)  # LRU touch
+        return blk
+
+    def publish(self, edge: tuple, blk: int, refs: int) -> int:
+        self._prefix.extend(edge)
+        key = tuple(self._prefix)
+        if blk in self._cache._published:
+            return blk  # already matchable (e.g. matched at admission)
+        existing = self._cache._map.get(key)
+        if existing is not None:
+            return existing  # another block already holds this content
+        self._cache._map[key] = blk
+        self._cache._published[blk] = key
+        self._cache._refs[blk] = refs
+        return blk
+
+
+def microbench(
+    n_entries: int = 10_000,
+    prompt_tokens: int = 4096,
+    block_size: int = 64,
+    n_match: int = 30,
+    n_evict: int = 50,
+    seed: int = 0,
+    include_flat: bool = False,
+) -> dict:
+    """Host-side cost of prefix-cache match and evict at serving scale:
+    a cache of ``n_entries`` published blocks built from distinct
+    ``prompt_tokens``-token prompts, then per-op mean microseconds for a
+    full-prompt match walk and for a victim eviction (which invalidates
+    the victim's whole descendant chain). ``include_flat=True`` also
+    measures :class:`FlatPrefixCache` — the old flat-map implementation
+    — for the speedup ratio pinned in tests/test_prefix_cache.py;
+    bench.py reports the radix numbers as ``prefix_match_us`` /
+    ``prefix_evict_us``. Pure host Python — no jax, no devices."""
+    import random
+    import time as _time
+
+    rng = random.Random(seed)
+    blocks_per = max(1, prompt_tokens // block_size)
+    n_prompts = max(1, (n_entries + blocks_per - 1) // blocks_per)
+    prompts = [
+        [rng.randrange(1 << 15) for _ in range(blocks_per * block_size)]
+        for _ in range(n_prompts)
+    ]
+    impls = [("radix", RadixPrefixCache)]
+    if include_flat:
+        impls.append(("flat", FlatPrefixCache))
+    out: dict = {}
+    for name, cls in impls:
+        cache = cls()
+        blk = 1
+        for p in prompts:
+            cur = cache.cursor()
+            for i in range(blocks_per):
+                cur.publish(
+                    tuple(p[i * block_size : (i + 1) * block_size]), blk, 0
+                )
+                blk += 1
+        t0 = _time.perf_counter()
+        for j in range(n_match):
+            p = prompts[j % n_prompts]
+            cur = cache.cursor()
+            for i in range((len(p) - 1) // block_size):
+                if cur.step(tuple(p[i * block_size : (i + 1) * block_size])) is None:
+                    break
+        match_us = (_time.perf_counter() - t0) / n_match * 1e6
+        n_e = min(n_evict, n_prompts)  # each evict retires a whole chain
+        t0 = _time.perf_counter()
+        for _ in range(n_e):
+            cache.pop_victim()
+        evict_us = (_time.perf_counter() - t0) / n_e * 1e6
+        out[name] = {
+            "entries": blocks_per * n_prompts,
+            "match_us": round(match_us, 2),
+            "evict_us": round(evict_us, 2),
+        }
+    return out

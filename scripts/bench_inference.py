@@ -260,6 +260,10 @@ def main():
             "acceptance": round(st["spec_accepted"] / st["spec_proposed"], 4)
             if st["spec_proposed"]
             else 0.0,
+            # spec_rounds counts REPLAYED slot-rounds (one slot, one
+            # draft+verify round the host actually committed from), so
+            # this is true mean tokens per productive round — discarded
+            # end-of-generation device rounds no longer skew it low
             "rounds": st["spec_rounds"],
             "committed_per_round_all_slots": round(
                 st["spec_committed"] / st["spec_rounds"], 2

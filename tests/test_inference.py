@@ -1265,15 +1265,68 @@ def test_pop_block_reclaims_orphaned_chain_descendants(params):
         engine.submit(list(range(1, 14)), 2).result(timeout=120)
     finally:
         engine.stop()
-    assert len(engine._prefix_map) == 3
+    assert len(engine._prefix_cache) == 3
     engine._free_blocks = []  # force the eviction path
     engine._pop_block()  # LRU-oldest = the chain head
-    assert engine._prefix_map == {} and engine._published == {}, (
+    assert len(engine._prefix_cache) == 0, (
         "orphaned descendants stayed published"
     )
     assert len(engine._free_blocks) == 2, (
         "orphaned ref-0 descendants must be freed immediately"
     )
+
+
+def test_pow2_buckets_contract_boundary():
+    """ADVICE r5: _pow2_buckets silently returned [1] for limit < 1,
+    violating its every-size-<=-limit contract; it must raise instead
+    (the call site asserts its span is positive before calling)."""
+    for bad in (0, -1, -7):
+        with pytest.raises(ValueError, match="limit >= 1"):
+            InferenceEngine._pow2_buckets(bad)
+    assert InferenceEngine._pow2_buckets(1) == [1]
+    assert InferenceEngine._pow2_buckets(5) == [1, 2, 4, 5]
+    assert InferenceEngine._pow2_buckets(5, include_limit=False) == [1, 2, 4]
+    assert InferenceEngine._pow2_buckets(8) == [1, 2, 4, 8]
+
+
+def test_spec_rounds_counts_replayed_rounds_only(params):
+    """ADVICE r5: spec_rounds used to count DISPATCHED device rounds
+    (spec_depth per dispatch) while proposed/committed only counted
+    replayed ones — with spec_depth>1, committed_per_round skewed low
+    near end-of-generation. Rounds now increment alongside proposed in
+    the host commit loop, so proposed == rounds * spec_k exactly, and a
+    request finishing in the first round of a depth-2 dispatch counts
+    ONE round, not two."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64,
+        draft_params=params, draft_cfg=CFG, spec_k=2, spec_depth=2,
+    ).start()
+    try:
+        # max_new=2: one token from prefill, then ONE spec dispatch whose
+        # first round commits the rest — the depth-2 dispatch's second
+        # round is discarded speculation and must not count
+        engine.submit([5, 1, 4], 2).result(timeout=120)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert st["spec_rounds"] == 1, st
+    assert st["spec_proposed"] == st["spec_rounds"] * 2
+    # a longer run keeps the invariant across many dispatches and slots
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64,
+        draft_params=params, draft_cfg=CFG, spec_k=3, spec_depth=2,
+    ).start()
+    try:
+        handles = [
+            engine.submit(p, n) for p, n in [([5, 1, 4], 9), ([2, 9], 7)]
+        ]
+        for h in handles:
+            h.result(timeout=300)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert st["spec_proposed"] == st["spec_rounds"] * 3, st
+    assert st["spec_committed"] <= st["spec_rounds"] * 4  # <= k+1 per round
 
 
 def test_prewarm_no_new_compiles(params):

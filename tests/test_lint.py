@@ -791,6 +791,54 @@ def test_render_refuses_hpa_on_multihost_slice():
     assert any(m["kind"] == "HorizontalPodAutoscaler" for m in ms)
 
 
+def test_render_hpa_check_scans_init_containers():
+    """ADVICE r5: a workload wiring TPU_WORKER_HOSTNAMES via an INIT
+    container is the same multi-host slice — the render-time HPA hard
+    error must fire for it too, not only for spec.template.spec
+    .containers."""
+    from devspace_tpu.deploy.chart import (
+        ChartError,
+        _check_hpa_slice_conflict,
+    )
+
+    def sts(workers, via_init):
+        env = [
+            {
+                "name": "TPU_WORKER_HOSTNAMES",
+                "value": ",".join(f"s-{i}.s" for i in range(workers)),
+            }
+        ]
+        container = {"name": "m", "image": "x:y", "env": env}
+        pod = (
+            {"initContainers": [container], "containers": [{"name": "m"}]}
+            if via_init
+            else {"containers": [container]}
+        )
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": "s"},
+            "spec": {"replicas": workers, "template": {"spec": pod}},
+        }
+
+    hpa = {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "s"},
+        "spec": {
+            "scaleTargetRef": {"kind": "StatefulSet", "name": "s"},
+            "maxReplicas": 8,
+            "metrics": [{"type": "Resource"}],
+        },
+    }
+    with pytest.raises(ChartError, match="topology, not load"):
+        _check_hpa_slice_conflict([sts(2, via_init=True), hpa])
+    # parity with the containers path, and single-host stays scalable
+    with pytest.raises(ChartError, match="topology, not load"):
+        _check_hpa_slice_conflict([sts(2, via_init=False), hpa])
+    _check_hpa_slice_conflict([sts(1, via_init=True), hpa])
+
+
 def test_lint_accepts_autoscaling_v1_hpa():
     """autoscaling/v1 HPAs (vendored upstream charts) scale via
     targetCPUUtilizationPercentage and have no metrics list — lint must

@@ -1,0 +1,259 @@
+"""Prefix-cache semantics: the radix tree (devspace_tpu/inference/
+prefix_cache.py) must be BEHAVIORALLY IDENTICAL to the flat
+OrderedDict implementation it replaced — same hits, same eviction
+victims, same descendant invalidation — while matching in O(prompt)
+and evicting in O(evicted chain). Pure host tests: no jax, no devices.
+"""
+
+import random
+
+import pytest
+
+from devspace_tpu.inference.prefix_cache import (
+    FlatPrefixCache,
+    RadixPrefixCache,
+    microbench,
+)
+
+BS = 4  # tokens per block in these tests
+
+
+def blocks(tokens):
+    return [tuple(tokens[i * BS : (i + 1) * BS]) for i in range(len(tokens) // BS)]
+
+
+def publish_chain(cache, tokens, first_blk, refs=0):
+    """Publish every full block of ``tokens`` under consecutive block ids
+    starting at ``first_blk``; returns the resident ids."""
+    cur = cache.cursor()
+    out = []
+    for i, edge in enumerate(blocks(tokens)):
+        out.append(cur.publish(edge, first_blk + i, refs))
+    return out
+
+
+def match(cache, tokens):
+    """Engine-shaped match: up to (len-1)//BS blocks, stop at first miss."""
+    cur = cache.cursor()
+    out = []
+    for i in range((len(tokens) - 1) // BS):
+        blk = cur.step(tuple(tokens[i * BS : (i + 1) * BS]))
+        if blk is None:
+            break
+        out.append(blk)
+    return out
+
+
+# -- deterministic semantics ----------------------------------------------
+@pytest.mark.parametrize("cls", [RadixPrefixCache, FlatPrefixCache])
+def test_publish_match_first_writer_wins(cls):
+    cache = cls()
+    tokens = list(range(12))  # 3 blocks
+    assert publish_chain(cache, tokens, 10) == [10, 11, 12]
+    assert len(cache) == 3
+    # a duplicate publish under different ids resolves to the residents
+    assert publish_chain(cache, tokens, 20) == [10, 11, 12]
+    assert len(cache) == 3 and not cache.is_published(20)
+    # a diverging chain shares the common prefix nodes only
+    other = tokens[:8] + [99, 98, 97, 96]
+    assert publish_chain(cache, other, 30) == [10, 11, 32]
+    assert match(cache, tokens + [0]) == [10, 11, 12]
+    assert match(cache, other + [0]) == [10, 11, 32]
+    # a miss mid-chain stops the walk
+    assert match(cache, tokens[:4] + [7, 7, 7, 7, 0]) == [10]
+
+
+@pytest.mark.parametrize("cls", [RadixPrefixCache, FlatPrefixCache])
+def test_mid_chain_eviction_invalidates_descendants(cls):
+    """Evicting a chain interior makes every descendant unmatchable:
+    ref-0 descendants are freed with the victim, in-use ones are
+    unpublished so their table release frees them."""
+    cache = cls()
+    tokens = list(range(16))  # 4 blocks
+    cur = cache.cursor()
+    edges = blocks(tokens)
+    cur.publish(edges[0], 10, 0)
+    cur.publish(edges[1], 11, 0)
+    cur.publish(edges[2], 12, 1)  # referenced by a live slot
+    cur.publish(edges[3], 13, 1)
+    assert len(cache) == 4 and cache.evictable() == 2
+    victim, freed = cache.pop_victim()
+    assert victim == 10  # least-recently-touched ref-0 = the chain head
+    assert freed == [11]  # ref-0 descendant returns to the free list
+    # the WHOLE chain is unpublished — including the in-use tail
+    assert len(cache) == 0 and cache.evictable() == 0
+    for b in (10, 11, 12, 13):
+        assert not cache.is_published(b)
+    assert match(cache, tokens + [0]) == []
+
+
+@pytest.mark.parametrize("cls", [RadixPrefixCache, FlatPrefixCache])
+def test_match_touch_protects_from_eviction(cls):
+    """LRU order follows match time: of two ref-0 chains, the one NOT
+    re-matched is the victim."""
+    cache = cls()
+    a, b = [1, 2, 3, 4], [5, 6, 7, 8]
+    publish_chain(cache, a, 10)
+    publish_chain(cache, b, 11)
+    assert match(cache, a + [0]) == [10]  # touch a -> b becomes LRU-oldest
+    victim, freed = cache.pop_victim()
+    assert victim == 11 and freed == []
+    assert cache.is_published(10)
+
+
+@pytest.mark.parametrize("cls", [RadixPrefixCache, FlatPrefixCache])
+def test_ref_release_gates_eviction(cls):
+    cache = cls()
+    publish_chain(cache, [1, 2, 3, 4], 10)
+    publish_chain(cache, [5, 6, 7, 8], 11)
+    cache.ref(10)
+    assert cache.evictable() == 1
+    assert cache.evictable_excluding([11]) == 0
+    victim, _ = cache.pop_victim()
+    assert victim == 11  # 10 is referenced, never a victim
+    with pytest.raises(RuntimeError, match="no block available"):
+        cache.pop_victim()
+    cache.release(10)
+    assert cache.evictable() == 1
+    victim, _ = cache.pop_victim()
+    assert victim == 10
+
+
+@pytest.mark.parametrize("cls", [RadixPrefixCache, FlatPrefixCache])
+def test_reset_clears_everything(cls):
+    cache = cls()
+    publish_chain(cache, list(range(12)), 10)
+    cache.ref(10)
+    cache.reset()
+    assert len(cache) == 0 and cache.evictable() == 0
+    assert match(cache, list(range(12)) + [0]) == []
+    with pytest.raises(RuntimeError):
+        cache.pop_victim()
+    # the tree is usable again after reset
+    assert publish_chain(cache, [9, 9, 9, 9], 50) == [50]
+    assert match(cache, [9, 9, 9, 9, 0]) == [50]
+
+
+# -- randomized trace equivalence -----------------------------------------
+def run_trace(cache_cls, seed, n_ops=400):
+    """Drive one cache implementation through an engine-shaped random
+    trace (admit = match+ref+alloc+publish, slot release, allocator
+    eviction, bare match) and record every observable: hit sequences,
+    publish residents, eviction victims and freed sets, counters. Block
+    ids are allocated engine-style (free list first, evict when dry), so
+    any behavioral divergence cascades into the log."""
+    rng = random.Random(seed)
+    cache = cache_cls()
+    log = []
+    refs: dict[int, int] = {}
+    free: list[int] = list(range(1000, 1064))  # bounded pool forces churn
+    slots: list[list[int]] = []
+    prompts: list[list[int]] = []
+
+    def gen_prompt():
+        if prompts and rng.random() < 0.65:
+            p = list(rng.choice(prompts))
+            cut = rng.randrange(0, len(p) // BS + 1) * BS
+            p = p[:cut]
+        else:
+            p = []
+        p += [rng.randrange(40) for _ in range(BS * rng.randrange(1, 5))]
+        prompts.append(p)
+        return p
+
+    def alloc():
+        if free:
+            return free.pop()
+        victim, freed = cache.pop_victim()
+        free.extend(sorted(freed))
+        log.append(("evict-for-alloc", victim, tuple(sorted(freed))))
+        return victim
+
+    for _ in range(n_ops):
+        op = rng.random()
+        if op < 0.40:  # admit
+            p = gen_prompt()
+            matched = match(cache, p)
+            need = len(p) // BS - len(matched)
+            avail = len(free) + cache.evictable_excluding(matched)
+            log.append(("match", tuple(matched), avail))
+            if need > avail:
+                log.append(("admit-full",))
+                continue
+            for b in matched:
+                refs[b] = refs.get(b, 0) + 1
+                cache.ref(b)
+            table = list(matched)
+            for _i in range(need):
+                b = alloc()
+                refs[b] = 1
+                table.append(b)
+            cur = cache.cursor()
+            residents = []
+            for i, edge in enumerate(blocks(p)):
+                residents.append(
+                    cur.publish(edge, table[i], refs.get(table[i], 0))
+                )
+            slots.append(table)
+            log.append(("publish", tuple(residents)))
+        elif op < 0.65 and slots:  # release a slot
+            table = slots.pop(rng.randrange(len(slots)))
+            for b in table:
+                refs[b] = refs.get(b, 1) - 1
+                if cache.is_published(b):
+                    cache.release(b)
+                elif refs[b] <= 0:
+                    free.append(b)
+            log.append(("release", tuple(table)))
+        elif op < 0.80:  # allocator pressure: evict one victim
+            if cache.evictable() > 0:
+                victim, freed = cache.pop_victim()
+                free.append(victim)
+                free.extend(sorted(freed))
+                refs[victim] = 0
+                log.append(("evict", victim, tuple(sorted(freed))))
+        else:  # bare match (touches LRU, no refs) — e.g. failed admit
+            p = gen_prompt()
+            log.append(("bare-match", tuple(match(cache, p))))
+        log.append(("state", len(cache), cache.evictable(), len(free)))
+    seen = sorted(
+        {b for t in slots for b in t}
+        | set(refs)
+        | set(range(1000, 1064))
+    )
+    log.append(("published", tuple(b for b in seen if cache.is_published(b))))
+    return log
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_radix_equals_flat_on_random_traces(seed):
+    """The tentpole invariant: on identical randomized publish / match /
+    ref / release / evict traces, the radix tree and the old flat map
+    produce IDENTICAL hit sequences, eviction victims, freed sets and
+    counters — the rewrite changed complexity, not behavior."""
+    flat = run_trace(FlatPrefixCache, seed)
+    radix = run_trace(RadixPrefixCache, seed)
+    assert len(flat) == len(radix)
+    for i, (f, r) in enumerate(zip(flat, radix)):
+        assert f == r, f"trace diverged at event {i}: flat={f} radix={r}"
+
+
+# -- the measured win ------------------------------------------------------
+def test_radix_order_of_magnitude_faster_at_scale():
+    """ISSUE 1 acceptance: on a 10k-entry cache with 4k-token prompts,
+    radix match+evict must be >= 10x faster than the flat map (measured
+    ~100x+ in practice — the margin absorbs CI timer noise). Also pins
+    that eviction no longer scans the full key set: flat evict grows
+    with cache size, radix with the evicted chain only."""
+    mb = microbench(
+        n_entries=10_000,
+        prompt_tokens=4096,
+        block_size=64,
+        n_match=10,
+        n_evict=20,
+        include_flat=True,
+    )
+    assert mb["radix"]["entries"] >= 10_000
+    flat_cost = mb["flat"]["match_us"] + mb["flat"]["evict_us"]
+    radix_cost = mb["radix"]["match_us"] + mb["radix"]["evict_us"]
+    assert flat_cost >= 10 * radix_cost, mb
