@@ -16,6 +16,7 @@ from typing import Optional
 
 from ..config import latest
 from ..kube.client import CRITICAL_STATUS, get_pod_status
+from ..utils.topology import parse_topology
 
 SETTLE_TIMEOUT = 120.0  # reference: analyze/pods.go:19
 IGNORE_POD_STATUS = {"Running", "Succeeded", "Completed", "Terminating"}
@@ -150,9 +151,7 @@ def analyze_tpu_slice(
         chips_per_worker = config.tpu.chips_per_worker or 1
         if topo:
             try:
-                product = 1
-                for part in topo.lower().split("x"):
-                    product *= int(part)
+                product = parse_topology(topo)
             except ValueError:
                 problems.append(
                     f"TPU slice {d.name}: unparseable topology {topo!r}"

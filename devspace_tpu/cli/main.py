@@ -148,6 +148,29 @@ def cmd_init(args) -> int:
 def cmd_deploy(args) -> int:
     """Reference: cmd/deploy.go — CI-style build+deploy, no dev overrides."""
     ctx = Context(args)
+    if not getattr(args, "skip_lint", False):
+        # preflight: a chart that renders broken objects must not reach
+        # the cluster — abort on lint ERRORS (warnings pass through)
+        from ..lint import ERROR
+        from ..lint.project import collect_project_findings
+
+        findings, _ = collect_project_findings(ctx)
+        errors = [f for f in findings if f.severity == ERROR]
+        if errors:
+            for f in sorted(errors, key=lambda f: f.sort_key()):
+                where = " ".join(p for p in (f.artifact, f.location) if p)
+                ctx.log.error(
+                    "[deploy] lint %s %s%s",
+                    f.rule_id,
+                    where + ": " if where else "",
+                    f.message,
+                )
+            ctx.log.error(
+                "[deploy] aborted: %d lint error(s) — fix them or rerun "
+                "with --skip-lint",
+                len(errors),
+            )
+            return 1
     build_and_deploy(
         ctx,
         dev_mode=False,
@@ -1018,58 +1041,79 @@ def cmd_update_packages(args) -> int:
     return rc
 
 
+def _lint_exit_code(findings, strict: bool) -> int:
+    """Pinned semantics: 0 clean, 1 on errors; warnings exit 0 unless
+    --strict promotes them."""
+    from ..lint import ERROR, WARNING
+
+    if any(f.severity == ERROR for f in findings):
+        return 1
+    if strict and any(f.severity == WARNING for f in findings):
+        return 1
+    return 0
+
+
+def _emit_lint_report(log, findings, fmt: str, n_objects: int) -> None:
+    from ..lint import ERROR, count_by_severity, reporters
+
+    if fmt != "text":
+        # machine formats go to stdout verbatim — logger decoration would
+        # corrupt the JSON/SARIF document
+        print(reporters.render(findings, fmt))
+        return
+    for f in sorted(findings, key=lambda f: f.sort_key()):
+        where = " ".join(p for p in (f.artifact, f.location) if p)
+        line = f"{f.rule_id} {where + ': ' if where else ''}{f.message}"
+        (log.warn if f.severity != ERROR else log.error)("[lint] %s", line)
+    counts = count_by_severity(findings)
+    if counts[ERROR]:
+        log.error(
+            "[lint] %d error(s), %d warning(s) across %d object(s)",
+            counts[ERROR],
+            counts["warning"],
+            n_objects,
+        )
+    elif findings:
+        log.warn(
+            "[lint] %d warning(s) across %d object(s)",
+            len(findings),
+            n_objects,
+        )
+    else:
+        log.done("[lint] %d object(s), no issues", n_objects)
+
+
 def cmd_lint(args) -> int:
     """Validate charts/manifests without applying: render every deployment
-    with its configured values (the exact deploy render path), check the
-    rendered objects structurally, and check TPU slice invariants at
-    render time (the live-pod versions live in `analyze`)."""
-    from ..deploy.chart import ChartDeployer, ChartError
-    from ..deploy.lint import lint_chart, lint_tpu_consistency, validate_manifests
-    from ..deploy.manifests import create_deployer
+    with its configured values (the exact deploy render path), run the
+    rule engine over the rendered objects (structure, TPU slice
+    invariants, image hygiene), and report as text, JSON, or SARIF."""
+    from ..lint import lint_chart_findings
+    from ..lint.project import collect_project_findings
 
+    fmt = getattr(args, "format", None) or "text"
+    strict = bool(getattr(args, "strict", False))
+    if fmt != "text":
+        # machine formats own stdout: push incidental log lines (backend
+        # banner, render warnings) to stderr so the document stays valid
+        logutil.set_logger(logutil.StdoutLogger(stream=sys.stderr))
     log = logutil.get_logger()
     if getattr(args, "chart", None):
         # standalone chart dir (no project config needed)
-        issues = [f"{args.chart}: {i}" for i in lint_chart(args.chart)]
-        for issue in issues:
-            log.warn("[lint] %s", issue)
-        if issues:
-            log.error("[lint] %d issue(s)", len(issues))
-            return 1
-        log.done("[lint] %s clean", args.chart)
-        return 0
+        findings = lint_chart_findings(args.chart)
+        for f in findings:
+            if not f.artifact:
+                f.artifact = args.chart
+        if findings or fmt != "text":
+            _emit_lint_report(log, findings, fmt, 0)
+        else:
+            log.done("[lint] %s clean", args.chart)
+        return _lint_exit_code(findings, strict)
 
     ctx = Context(args)
-    cache = ctx.loader.generated.get_active().deploy
-    image_tags = dict(cache.image_tags or {})
-    for k, v in (ctx.config.images or {}).items():
-        if v.image:
-            image_tags.setdefault(k, f"{v.image}:dev")
-    issues: list[str] = []
-    all_docs: list[dict] = []
-    for d in ctx.config.deployments or []:
-        deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
-        try:
-            if isinstance(deployer, ChartDeployer):
-                docs = deployer.render_manifests(
-                    image_tags=image_tags, tpu=ctx.config.tpu
-                )
-            else:
-                docs = deployer.render_manifests(image_tags=image_tags)
-        except (ChartError, OSError) as e:
-            issues.append(f"{d.name}: render failed: {e}")
-            continue
-        issues.extend(f"{d.name}: {i}" for i in validate_manifests(docs))
-        all_docs.extend(docs)
-    # slice invariants span deployments (the tpu block is config-global)
-    issues.extend(lint_tpu_consistency(all_docs, ctx.config.tpu))
-    for issue in issues:
-        log.warn("[lint] %s", issue)
-    if issues:
-        log.error("[lint] %d issue(s) across %d object(s)", len(issues), len(all_docs))
-        return 1
-    log.done("[lint] %d object(s), no issues", len(all_docs))
-    return 0
+    findings, n_objects = collect_project_findings(ctx)
+    _emit_lint_report(log, findings, fmt, n_objects)
+    return _lint_exit_code(findings, strict)
 
 
 def _checkout_root() -> str:
@@ -1383,6 +1427,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("deploy", help="build and deploy (CI mode)")
     sp.add_argument("--force-build", "-b", action="store_true")
     sp.add_argument("--force-deploy", "-d", action="store_true")
+    sp.add_argument(
+        "--skip-lint",
+        action="store_true",
+        help="skip the lint preflight (errors normally abort the deploy)",
+    )
     sp.set_defaults(fn=cmd_deploy)
 
     sp = sub.add_parser("enter", help="open a shell in a slice worker")
@@ -1553,6 +1602,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument(
         "--chart", help="lint a standalone chart dir instead of the project"
+    )
+    sp.add_argument(
+        "--format",
+        choices=["text", "json", "sarif"],
+        default="text",
+        help="report format (sarif suits CI code-scanning upload)",
+    )
+    sp.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit non-zero on warnings too, not just errors",
     )
     sp.set_defaults(fn=cmd_lint)
 

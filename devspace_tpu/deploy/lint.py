@@ -1,235 +1,51 @@
-"""Chart/config lint — validate before touching the cluster.
+"""Chart/config lint — legacy list-of-strings API.
 
-Reference parity: helm's client-side checks before install
+Compat shims over the rule-engine subsystem (``devspace_tpu.lint``): the
+checks that used to live here as one monolith are now registered rules
+with stable ids, severities, and structured findings (text/JSON/SARIF
+reporters, sharding and Dockerfile packs). These wrappers run exactly the
+historical rule set and return the historical strings, so existing
+callers and tests see no change.
+
+Reference parity (unchanged): helm's client-side checks before install
 (``/root/reference/pkg/devspace/helm/install.go:54`` loads + requirement-
 checks the chart; ``helm lint`` upstream renders with default values and
 schema-checks the objects). TPU-first addition: the render-time half of
-analyze's slice preflights (``analyze/analyze.py:analyze_tpu_slice``
-checks live pods; lint checks the SAME invariants on the rendered
-manifests, so a broken topology is caught before anything is applied).
+analyze's slice preflights.
 
-Three layers:
-- ``validate_manifests`` — structural object checks (apiVersion/kind/
-  metadata, DNS-1123 names, duplicate ids, container images, selector
-  wiring, workload basics);
+- ``validate_manifests`` — structural object checks (rules DS101-DS106);
 - ``lint_tpu_consistency`` — slice invariants for configs with a
-  ``tpu:`` block (worker count vs replicas, topology product vs chips,
-  google.com/tpu resources, TPU_WORKER_ID/HOSTNAMES/coordinator env
-  wiring, headless-service discovery);
-- ``lint_chart`` / ``lint_deployments`` — render (defaults + provided
-  values, the SAME path deploy uses) then run both check layers.
+  ``tpu:`` block (rules TPU201-TPU205);
+- ``lint_chart`` — render (defaults + provided values, the SAME path
+  deploy uses) then run both layers.
+
+New code should prefer ``devspace_tpu.lint`` directly: it adds hygiene/
+sharding/image rules and keeps severity and rule-id information the
+string form throws away.
 """
 
 from __future__ import annotations
 
-import re
 from typing import Optional
 
 from ..config import latest
-
-# DNS-1123 SUBDOMAIN (dots allowed): most resource names accept it, and
-# CRDs ('certificates.cert-manager.io') require it — a label-only regex
-# would false-positive on valid charts
-_DNS1123 = re.compile(
-    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$"
+from ..lint import (
+    LEGACY_MANIFEST_CATEGORIES,
+    LEGACY_TPU_CATEGORIES,
+    LintContext,
+    run_rules,
 )
-_WORKLOAD_KINDS = {
-    "Deployment",
-    "StatefulSet",
-    "DaemonSet",
-    "Job",
-    "ReplicaSet",
-}
-# k8s resource.Quantity for storage requests (decimal/binary SI suffixes)
-_QUANTITY = re.compile(r"^[0-9]+(\.[0-9]+)?(m|k|Ki|M|Mi|G|Gi|T|Ti|P|Pi|E|Ei)?$")
-_ACCESS_MODES = {
-    "ReadWriteOnce",
-    "ReadOnlyMany",
-    "ReadWriteMany",
-    "ReadWriteOncePod",
-}
-
-
-def _lint_claim_spec(label: str, spec: dict, issues: list) -> None:
-    """Shared PVC-spec checks for standalone claims and StatefulSet
-    volumeClaimTemplates."""
-    storage = (
-        ((spec.get("resources") or {}).get("requests") or {}).get("storage")
-    )
-    if not storage:
-        issues.append(f"{label}: no resources.requests.storage")
-    elif not _QUANTITY.match(str(storage)):
-        issues.append(
-            f"{label}: storage {storage!r} is not a k8s quantity "
-            f"(e.g. 5Gi, 500Mi)"
-        )
-    for mode in spec.get("accessModes") or []:
-        if mode not in _ACCESS_MODES:
-            issues.append(f"{label}: unknown accessMode {mode!r}")
-    sc = spec.get("storageClassName")
-    if sc is not None and (not isinstance(sc, str) or not sc):
-        issues.append(f"{label}: storageClassName must be a non-empty string")
-
-
-def _containers(doc: dict) -> list[dict]:
-    spec = doc.get("spec") or {}
-    if doc.get("kind") == "Pod":
-        return (spec.get("containers") or []) + (spec.get("initContainers") or [])
-    tmpl = (spec.get("template") or {}).get("spec") or {}
-    return (tmpl.get("containers") or []) + (tmpl.get("initContainers") or [])
-
-
-def _pod_spec(doc: dict) -> dict:
-    spec = doc.get("spec") or {}
-    if doc.get("kind") == "Pod":
-        return spec
-    return (spec.get("template") or {}).get("spec") or {}
 
 
 def validate_manifests(docs: list[dict]) -> list[str]:
     """Structural checks every rendered object must pass. Returns issue
     strings ('' prefix-tagged with KIND/name so reports read well)."""
-    issues: list[str] = []
-    seen: set[tuple[str, str, str]] = set()
-    for i, doc in enumerate(docs):
-        if not isinstance(doc, dict) or not doc:
-            issues.append(f"document #{i}: not a mapping ({type(doc).__name__})")
-            continue
-        kind = doc.get("kind")
-        api = doc.get("apiVersion")
-        meta = doc.get("metadata") or {}
-        name = meta.get("name")
-        label = f"{kind or '?'}/{name or f'#{i}'}"
-        if not api:
-            issues.append(f"{label}: missing apiVersion")
-        if not kind:
-            issues.append(f"{label}: missing kind")
-        if not name:
-            issues.append(f"{label}: missing metadata.name")
-        elif not _DNS1123.match(str(name)) or len(str(name)) > 253:
-            issues.append(f"{label}: metadata.name not DNS-1123 ({name!r})")
-        if kind and name:
-            key = (str(kind), str(name), str(meta.get("namespace") or ""))
-            if key in seen:
-                issues.append(f"{label}: duplicate object (kind+name+namespace)")
-            seen.add(key)
-        for c in _containers(doc):
-            cname = c.get("name") or "?"
-            if not c.get("name"):
-                issues.append(f"{label}: container without a name")
-            if not c.get("image"):
-                issues.append(f"{label}: container {cname} has no image")
-        if kind in _WORKLOAD_KINDS and kind != "DaemonSet":
-            sel = ((doc.get("spec") or {}).get("selector") or {}).get(
-                "matchLabels"
-            ) or {}
-            tmpl_labels = (
-                ((doc.get("spec") or {}).get("template") or {}).get("metadata")
-                or {}
-            ).get("labels") or {}
-            if sel and any(tmpl_labels.get(k) != v for k, v in sel.items()):
-                issues.append(
-                    f"{label}: selector.matchLabels not matched by "
-                    f"template labels ({sel} vs {tmpl_labels})"
-                )
-        if kind == "PersistentVolumeClaim":
-            _lint_claim_spec(label, doc.get("spec") or {}, issues)
-        if kind in _WORKLOAD_KINDS or kind == "Pod":
-            pod = _pod_spec(doc)
-            declared = {
-                v.get("name")
-                for v in pod.get("volumes") or []
-                if isinstance(v, dict)
-            }
-            for tmpl in (doc.get("spec") or {}).get(
-                "volumeClaimTemplates"
-            ) or []:
-                tname = (tmpl.get("metadata") or {}).get("name")
-                tlabel = f"{label}: volumeClaimTemplates[{tname or '?'}]"
-                if not tname:
-                    issues.append(f"{tlabel}: missing metadata.name")
-                elif not _DNS1123.match(str(tname)):
-                    issues.append(f"{tlabel}: name not DNS-1123")
-                else:
-                    declared.add(tname)
-                _lint_claim_spec(tlabel, tmpl.get("spec") or {}, issues)
-            for c in _containers(doc):
-                for m in c.get("volumeMounts") or []:
-                    mname = m.get("name") if isinstance(m, dict) else None
-                    if not mname or not m.get("mountPath"):
-                        issues.append(
-                            f"{label}: container {c.get('name', '?')} has a "
-                            f"volumeMount without name+mountPath ({m!r})"
-                        )
-                    elif mname not in declared:
-                        issues.append(
-                            f"{label}: container {c.get('name', '?')} mounts "
-                            f"undeclared volume {mname!r} (pod volumes/"
-                            f"claimTemplates: {sorted(declared) or 'none'})"
-                        )
-        if kind == "HorizontalPodAutoscaler":
-            spec = doc.get("spec") or {}
-            ref = spec.get("scaleTargetRef") or {}
-            if not ref.get("kind") or not ref.get("name"):
-                issues.append(
-                    f"{label}: scaleTargetRef needs kind+name ({ref!r})"
-                )
-            else:
-                resolved = any(
-                    isinstance(d, dict)
-                    and d.get("kind") == ref["kind"]
-                    and (d.get("metadata") or {}).get("name") == ref["name"]
-                    for d in docs
-                )
-                if not resolved:
-                    issues.append(
-                        f"{label}: scaleTargetRef {ref['kind']}/"
-                        f"{ref['name']} is not among the rendered objects"
-                    )
-            max_r = spec.get("maxReplicas")
-            min_r = spec.get("minReplicas", 1)
-            if not isinstance(max_r, int) or max_r < 1:
-                issues.append(
-                    f"{label}: maxReplicas must be a positive integer "
-                    f"({max_r!r})"
-                )
-            elif isinstance(min_r, int) and min_r > max_r:
-                issues.append(
-                    f"{label}: minReplicas {min_r} > maxReplicas {max_r}"
-                )
-            if not isinstance(min_r, int):
-                issues.append(
-                    f"{label}: minReplicas must be an integer ({min_r!r})"
-                )
-            elif min_r < 1:
-                issues.append(f"{label}: minReplicas must be >= 1 ({min_r})")
-            # v2-only: autoscaling/v1 scales via
-            # spec.targetCPUUtilizationPercentage and has no metrics list
-            # (vendored upstream charts legitimately render v1 objects)
-            if str(api).startswith("autoscaling/v2") and not spec.get(
-                "metrics"
-            ):
-                issues.append(
-                    f"{label}: no metrics — the HPA could never scale"
-                )
-        if kind == "StatefulSet":
-            svc = (doc.get("spec") or {}).get("serviceName")
-            if not svc:
-                issues.append(f"{label}: StatefulSet without serviceName")
-            else:
-                has_headless = any(
-                    isinstance(d, dict)
-                    and d.get("kind") == "Service"
-                    and (d.get("metadata") or {}).get("name") == svc
-                    and (d.get("spec") or {}).get("clusterIP") in (None, "None")
-                    for d in docs
-                )
-                if not has_headless:
-                    issues.append(
-                        f"{label}: serviceName '{svc}' has no (headless) "
-                        f"Service in the rendered objects"
-                    )
-    return issues
+    ctx = LintContext(docs=docs)
+    return [
+        f.legacy()
+        for f in run_rules(ctx, categories=LEGACY_MANIFEST_CATEGORIES)
+        if f.rule_id != "DS100"
+    ]
 
 
 def lint_tpu_consistency(
@@ -237,124 +53,8 @@ def lint_tpu_consistency(
 ) -> list[str]:
     """Render-time slice invariants (live-pod versions of the same checks:
     analyze/analyze.py:analyze_tpu_slice)."""
-    if tpu is None or not (tpu.workers or tpu.topology or tpu.accelerator):
-        return []
-    issues: list[str] = []
-    workers = tpu.workers or 1
-    chips_per_worker = tpu.chips_per_worker or 1
-    # topology product vs slice chips
-    if tpu.topology:
-        try:
-            product = 1
-            for part in str(tpu.topology).lower().split("x"):
-                product *= int(part)
-        except ValueError:
-            issues.append(f"tpu: unparseable topology {tpu.topology!r}")
-            product = None
-        if product is not None and product != workers * chips_per_worker:
-            issues.append(
-                f"tpu: topology {tpu.topology} has {product} chips but "
-                f"workers x chipsPerWorker = {workers * chips_per_worker}"
-            )
-    slice_workloads = 0
-    slice_ids: set[tuple[str, str]] = set()
-    for doc in docs:
-        if not isinstance(doc, dict) or doc.get("kind") not in _WORKLOAD_KINDS:
-            continue
-        pod = _pod_spec(doc)
-        containers = _containers(doc)
-        requests_tpu = any(
-            "google.com/tpu" in ((c.get("resources") or {}).get("limits") or {})
-            or "google.com/tpu"
-            in ((c.get("resources") or {}).get("requests") or {})
-            for c in containers
-        )
-        env_names = {
-            e.get("name")
-            for c in containers
-            for e in c.get("env") or []
-            if isinstance(e, dict)
-        }
-        is_slice = requests_tpu or {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"} & env_names
-        if not is_slice:
-            continue
-        slice_workloads += 1
-        slice_ids.add(
-            (str(doc.get("kind")), str((doc.get("metadata") or {}).get("name")))
-        )
-        label = f"{doc.get('kind')}/{(doc.get('metadata') or {}).get('name')}"
-        replicas = (doc.get("spec") or {}).get("replicas")
-        if replicas is not None:
-            try:
-                replicas_n = int(replicas)
-            except (TypeError, ValueError):
-                issues.append(f"{label}: replicas is not an integer ({replicas!r})")
-                replicas_n = None
-            if replicas_n is not None and replicas_n != workers:
-                issues.append(
-                    f"{label}: replicas {replicas} != tpu.workers {workers} "
-                    f"(slice atomicity: every worker pod must exist)"
-                )
-        if not requests_tpu:
-            issues.append(
-                f"{label}: TPU env wired but no container requests "
-                f"google.com/tpu resources"
-            )
-        for want in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"):
-            if want not in env_names:
-                issues.append(f"{label}: missing {want} env")
-        if workers > 1 and "JAX_COORDINATOR_ADDRESS" not in env_names:
-            issues.append(
-                f"{label}: multi-worker slice without JAX_COORDINATOR_ADDRESS"
-            )
-        if doc.get("kind") != "StatefulSet" and workers > 1:
-            issues.append(
-                f"{label}: multi-worker slices need stable identities — "
-                f"use a StatefulSet (got {doc.get('kind')})"
-            )
-        # static hostname lists must match the worker count
-        for c in containers:
-            for e in c.get("env") or []:
-                if (
-                    isinstance(e, dict)
-                    and e.get("name") == "TPU_WORKER_HOSTNAMES"
-                    and isinstance(e.get("value"), str)
-                    and e["value"]
-                ):
-                    got = len([h for h in e["value"].split(",") if h])
-                    if got != workers:
-                        issues.append(
-                            f"{label}: TPU_WORKER_HOSTNAMES lists {got} "
-                            f"host(s), expected {workers}"
-                        )
-    if slice_workloads == 0:
-        issues.append(
-            "tpu: config has a tpu block but no rendered workload requests "
-            "google.com/tpu or wires TPU_WORKER_ID/TPU_WORKER_HOSTNAMES"
-        )
-    # Slice atomicity vs autoscaling: a MULTI-host slice's worker count
-    # is topology (every ordinal must exist — TPU_WORKER_HOSTNAMES is a
-    # static roster), so an HPA must never resize it. Single-host slice
-    # workloads (workers == 1) may scale: each replica is an independent
-    # model server on its own TPU host (the serving story).
-    if workers > 1:
-        for doc in docs:
-            if (
-                not isinstance(doc, dict)
-                or doc.get("kind") != "HorizontalPodAutoscaler"
-            ):
-                continue
-            ref = ((doc.get("spec") or {}).get("scaleTargetRef")) or {}
-            if (str(ref.get("kind")), str(ref.get("name"))) in slice_ids:
-                issues.append(
-                    f"HorizontalPodAutoscaler/"
-                    f"{(doc.get('metadata') or {}).get('name')}: targets "
-                    f"multi-host slice workload {ref.get('kind')}/"
-                    f"{ref.get('name')} ({workers} workers) — slice worker "
-                    f"count is topology, not load; HPAs fit single-host "
-                    f"serving replicas only"
-                )
-    return issues
+    ctx = LintContext(docs=docs, tpu=tpu)
+    return [f.legacy() for f in run_rules(ctx, categories=LEGACY_TPU_CATEGORIES)]
 
 
 def lint_chart(

@@ -1,0 +1,73 @@
+"""Unified preflight analyzer: a rule-engine lint subsystem.
+
+Everything the framework can know is wrong *before* a slice boots lives
+here — rendered-manifest structure, TPU slice invariants, static JAX
+sharding/mesh checks, and Dockerfile hygiene — as registered rules with
+stable ids producing structured findings, reportable as text, JSON, or
+SARIF 2.1.0.
+
+The historical ``devspace_tpu.deploy.lint`` functions remain as thin
+compat shims over this package.
+"""
+
+from .engine import (
+    CHART_CATEGORIES,
+    ERROR,
+    INFO,
+    LEGACY_MANIFEST_CATEGORIES,
+    LEGACY_TPU_CATEGORIES,
+    REGISTRY,
+    SEVERITIES,
+    WARNING,
+    Finding,
+    LintContext,
+    Rule,
+    count_by_severity,
+    lint_chart_findings,
+    lint_docs,
+    render_failure,
+    rule,
+    run_rules,
+)
+
+# importing the packs registers their rules
+from . import rules_manifest  # noqa: E402,F401
+from . import rules_tpu  # noqa: E402,F401
+from . import rules_sharding  # noqa: E402,F401
+from . import rules_docker  # noqa: E402,F401
+
+from .rules_docker import lint_dockerfile
+from .rules_sharding import (
+    donation_preflight,
+    mesh_axes_for_tpu,
+    sharding_preflight,
+)
+from .project import collect_project_findings, has_errors
+from . import reporters
+
+__all__ = [
+    "CHART_CATEGORIES",
+    "ERROR",
+    "INFO",
+    "LEGACY_MANIFEST_CATEGORIES",
+    "LEGACY_TPU_CATEGORIES",
+    "REGISTRY",
+    "SEVERITIES",
+    "WARNING",
+    "Finding",
+    "LintContext",
+    "Rule",
+    "collect_project_findings",
+    "count_by_severity",
+    "donation_preflight",
+    "has_errors",
+    "lint_chart_findings",
+    "lint_docs",
+    "lint_dockerfile",
+    "mesh_axes_for_tpu",
+    "render_failure",
+    "reporters",
+    "rule",
+    "run_rules",
+    "sharding_preflight",
+]

@@ -1,0 +1,216 @@
+"""Rule-engine core for the preflight analyzer.
+
+Helm-style shift-left checking grown into a real static-analysis subsystem:
+every check is a registered :class:`Rule` (stable id, severity, category)
+producing structured :class:`Finding` objects that the reporters render as
+text, machine-stable JSON, or SARIF 2.1.0 for CI code-scanning upload.
+
+Rule packs register themselves at import time (see ``rules_manifest``,
+``rules_tpu``, ``rules_sharding``, ``rules_docker``); ``run_rules`` walks
+the registry in id order so output is deterministic by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, INFO)
+
+
+@dataclass
+class Finding:
+    """One diagnostic: what rule fired, how bad, where."""
+
+    rule_id: str
+    severity: str
+    category: str
+    message: str
+    location: str = ""  # logical location, e.g. "StatefulSet/slice"
+    artifact: str = ""  # file / chart dir / deployment the finding is in
+
+    def legacy(self) -> str:
+        """The pre-engine string form (``KIND/name: message``) — the compat
+        shims in ``deploy.lint`` return exactly these."""
+        return f"{self.location}: {self.message}" if self.location else self.message
+
+    def sort_key(self) -> tuple:
+        return (self.artifact, self.location, self.rule_id, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "category": self.category,
+            "message": self.message,
+            "location": self.location,
+            "artifact": self.artifact,
+        }
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    category: str
+    description: str
+    check: Callable[["LintContext"], Optional[Iterable]]
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, *, severity: str, category: str, description: str):
+    """Register a check. The decorated function takes a
+    :class:`LintContext` and yields findings as ``(location, message)``
+    tuples, bare message strings, or prebuilt :class:`Finding` objects; a
+    rule whose inputs are absent from the context simply yields nothing."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"{rule_id}: unknown severity {severity!r}")
+
+    def deco(fn):
+        if rule_id in REGISTRY:
+            raise ValueError(f"duplicate lint rule id {rule_id}")
+        REGISTRY[rule_id] = Rule(rule_id, severity, category, description, fn)
+        return fn
+
+    return deco
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may inspect. Packs read only their own fields:
+    manifest/tpu rules use ``docs``+``tpu``, docker rules ``dockerfiles``,
+    sharding rules ``mesh_axes``/``shardings``/``donation``."""
+
+    docs: list = field(default_factory=list)
+    tpu: object = None  # latest.TPUConfig
+    # [(path, text, tpu_flavor)] — tpu_flavor turns on the JAX/TPU checks
+    dockerfiles: list = field(default_factory=list)
+    mesh_axes: Optional[dict] = None  # axis name -> size (resolved, no -1)
+    # name -> (shape-like | ShapeDtypeStruct, PartitionSpec)
+    shardings: Optional[dict] = None
+    # {"fn", "args", "kwargs", "donate_argnums"}
+    donation: Optional[dict] = None
+    artifact: str = ""  # default artifact tag for produced findings
+
+
+def run_rules(
+    ctx: LintContext,
+    categories: Optional[set] = None,
+    only: Optional[set] = None,
+) -> list[Finding]:
+    """Run every registered rule (optionally filtered by category/id)
+    against the context. Deterministic: rules run in id order, each rule
+    visits ``ctx.docs`` in document order."""
+    findings: list[Finding] = []
+    for rule_id in sorted(REGISTRY):
+        r = REGISTRY[rule_id]
+        if categories is not None and r.category not in categories:
+            continue
+        if only is not None and rule_id not in only:
+            continue
+        for item in r.check(ctx) or ():
+            if isinstance(item, Finding):
+                if not item.artifact:
+                    item.artifact = ctx.artifact
+                findings.append(item)
+                continue
+            if isinstance(item, tuple):
+                location, message = item
+            else:
+                location, message = "", str(item)
+            findings.append(
+                Finding(
+                    rule_id=r.id,
+                    severity=r.severity,
+                    category=r.category,
+                    message=message,
+                    location=location,
+                    artifact=ctx.artifact,
+                )
+            )
+    return findings
+
+
+def count_by_severity(findings: Iterable[Finding]) -> dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+# Categories covered by the pre-engine deploy.lint API — the compat shims
+# run exactly these so their output stays what tests/test_lint.py pins.
+LEGACY_MANIFEST_CATEGORIES = frozenset({"manifest"})
+LEGACY_TPU_CATEGORIES = frozenset({"tpu"})
+# Everything the chart-level entry points run (hygiene is new: advisory
+# rules the legacy list-of-strings API never reported).
+CHART_CATEGORIES = frozenset({"manifest", "tpu", "hygiene"})
+
+
+def render_failure(chart_path: str, error: Exception) -> Finding:
+    """A chart that does not render IS the lint finding (rule DS100)."""
+    return Finding(
+        rule_id="DS100",
+        severity=ERROR,
+        category="manifest",
+        message=f"render failed: {error}",
+        artifact=chart_path,
+    )
+
+
+@rule(
+    "DS100",
+    severity=ERROR,
+    category="manifest",
+    description="Chart must render with the provided/default values",
+)
+def _render_ok(ctx: LintContext):
+    # Render failures are synthesized by the callers that actually render
+    # (lint_chart_findings / project collection) via render_failure();
+    # the registration exists so DS100 appears in the rule catalog.
+    return ()
+
+
+def lint_docs(
+    docs: list,
+    tpu=None,
+    artifact: str = "",
+    categories: Optional[set] = CHART_CATEGORIES,
+) -> list[Finding]:
+    """Run the manifest-object rule packs over rendered documents."""
+    ctx = LintContext(docs=docs, tpu=tpu, artifact=artifact)
+    return run_rules(ctx, categories=categories)
+
+
+def lint_chart_findings(
+    chart_path: str,
+    release_name: str = "lint",
+    namespace: str = "default",
+    values: Optional[dict] = None,
+    value_files: Optional[list] = None,
+    tpu=None,
+    extra_context: Optional[dict] = None,
+) -> list[Finding]:
+    """Render a chart (defaults + provided values — the same path deploy
+    uses) and run the full manifest/tpu/hygiene packs. A render failure
+    is returned as the single DS100 finding."""
+    from ..deploy.chart import ChartError, render_chart
+    from ..deploy.gotemplate import TemplateError
+
+    try:
+        docs = render_chart(
+            chart_path,
+            release_name=release_name,
+            namespace=namespace,
+            values=values,
+            value_files=value_files,
+            extra_context=extra_context,
+        )
+    except (ChartError, TemplateError, OSError) as e:
+        return [render_failure(chart_path, e)]
+    return lint_docs(docs, tpu=tpu, artifact=chart_path)

@@ -1,0 +1,106 @@
+"""Project-wide finding collection — the engine behind ``devspace-tpu
+lint`` and the deploy preflight.
+
+Renders every configured deployment through the exact deploy render path
+(same image-tag fallbacks, same tpu context), runs the manifest/tpu/
+hygiene packs over the rendered objects, and the image pack over every
+configured Dockerfile. One function so ``cmd_lint`` and ``cmd_deploy``
+cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .engine import (
+    CHART_CATEGORIES,
+    ERROR,
+    Finding,
+    LintContext,
+    lint_docs,
+    render_failure,
+    run_rules,
+)
+
+
+def _tpu_flavor(config) -> bool:
+    tpu = getattr(config, "tpu", None)
+    return tpu is not None and bool(
+        tpu.workers or tpu.topology or tpu.accelerator
+    )
+
+
+def collect_project_findings(ctx) -> tuple[list[Finding], int]:
+    """All findings for a loaded project context (the CLI ``Context``).
+
+    Returns ``(findings, n_objects)`` — the rendered-object count feeds
+    the CLI summary line. Render failures become DS100 findings rather
+    than exceptions so one broken deployment doesn't hide the others."""
+    from ..deploy.chart import ChartDeployer, ChartError
+    from ..deploy.gotemplate import TemplateError
+    from ..deploy.manifests import create_deployer
+
+    findings: list[Finding] = []
+    image_tags = dict(
+        (ctx.loader.generated.get_active().deploy.image_tags or {})
+    )
+    for k, v in (ctx.config.images or {}).items():
+        if v.image:
+            image_tags.setdefault(k, f"{v.image}:dev")
+
+    all_docs: list[dict] = []
+    for d in ctx.config.deployments or []:
+        deployer = create_deployer(ctx.backend, d, ctx.namespace, ctx.root, ctx.log)
+        try:
+            if isinstance(deployer, ChartDeployer):
+                docs = deployer.render_manifests(
+                    image_tags=image_tags, tpu=ctx.config.tpu
+                )
+            else:
+                docs = deployer.render_manifests(image_tags=image_tags)
+        except (ChartError, TemplateError, OSError) as e:
+            f = render_failure(d.name, e)
+            f.artifact = d.name
+            findings.append(f)
+            continue
+        # structural + hygiene per deployment (findings carry the
+        # deployment name); slice invariants run once across ALL
+        # deployments below — the tpu block is config-global
+        findings.extend(
+            lint_docs(
+                docs,
+                artifact=d.name,
+                categories=CHART_CATEGORIES - {"tpu"},
+            )
+        )
+        all_docs.extend(docs)
+    findings.extend(
+        run_rules(
+            LintContext(docs=all_docs, tpu=ctx.config.tpu),
+            categories={"tpu"},
+        )
+    )
+
+    dockerfiles = []
+    flavor = _tpu_flavor(ctx.config)
+    for _, img in sorted((ctx.config.images or {}).items()):
+        rel = img.dockerfile or "Dockerfile"
+        path = os.path.join(ctx.root, rel)
+        if not os.path.isfile(path):
+            continue  # the build pipeline owns missing-file errors
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                dockerfiles.append((rel, fh.read(), flavor))
+        except OSError:
+            continue
+    if dockerfiles:
+        findings.extend(
+            run_rules(
+                LintContext(dockerfiles=dockerfiles), categories={"image"}
+            )
+        )
+    return findings, len(all_docs)
+
+
+def has_errors(findings) -> bool:
+    return any(f.severity == ERROR for f in findings)

@@ -1,0 +1,189 @@
+"""Dockerfile lint for the images the framework builds and scaffolds.
+
+TPU-first: a JAX slice container that forgets the TPU client stack
+(``jax[tpu]``/libtpu) silently falls back to CPU and burns the whole
+reservation, and a CUDA base image can never see a TPU at all — both are
+client-side-detectable from the Dockerfile text, so they belong in the
+preflight, not in a post-boot log dive.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator
+
+from .engine import ERROR, Finding, LintContext, WARNING, rule
+
+_TPU_STACK = re.compile(r"jax\s*\[\s*tpu\s*\]|libtpu", re.IGNORECASE)
+_TPU_ENV = re.compile(r"^(TPU_|JAX_PLATFORMS)", re.IGNORECASE)
+_GPU_BASE = re.compile(r"nvidia|cuda|rocm", re.IGNORECASE)
+
+
+def parse_instructions(text: str) -> list[tuple[str, str]]:
+    """(KEYWORD, rest) per logical Dockerfile instruction; comments
+    stripped, backslash continuations joined."""
+    out: list[tuple[str, str]] = []
+    logical = ""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.endswith("\\"):
+            logical += line[:-1] + " "
+            continue
+        logical += line
+        parts = logical.split(None, 1)
+        if parts:
+            out.append((parts[0].upper(), parts[1] if len(parts) > 1 else ""))
+        logical = ""
+    if logical.strip():
+        parts = logical.split(None, 1)
+        out.append((parts[0].upper(), parts[1] if len(parts) > 1 else ""))
+    return out
+
+
+def _final_stage_base(instructions: list[tuple[str, str]]) -> str:
+    """Base image of the LAST build stage (multi-stage builds ship only
+    the final stage)."""
+    base = ""
+    for kw, rest in instructions:
+        if kw == "FROM":
+            base = rest.split()[0] if rest.split() else ""
+    return base
+
+
+def _entrypoint_text(instructions: list[tuple[str, str]]) -> str:
+    """The effective process line: last ENTRYPOINT + last CMD."""
+    cmd = entry = ""
+    for kw, rest in instructions:
+        if kw == "CMD":
+            cmd = rest
+        elif kw == "ENTRYPOINT":
+            entry = rest
+    return f"{entry} {cmd}".strip()
+
+
+def _each_dockerfile(ctx: LintContext) -> Iterator[tuple[str, list, bool]]:
+    for path, text, tpu_flavor in ctx.dockerfiles or ():
+        yield path, parse_instructions(text), bool(tpu_flavor)
+
+
+@rule(
+    "IMG401",
+    severity=ERROR,
+    category="image",
+    description="TPU workload images must install the TPU client stack "
+    "(jax[tpu]/libtpu) or wire TPU env",
+)
+def check_tpu_stack(ctx: LintContext):
+    for path, instructions, tpu_flavor in _each_dockerfile(ctx):
+        if not tpu_flavor:
+            continue
+        has_stack = any(
+            kw == "RUN" and _TPU_STACK.search(rest) for kw, rest in instructions
+        )
+        has_env = any(
+            kw == "ENV" and _TPU_ENV.match(rest) for kw, rest in instructions
+        )
+        if not has_stack and not has_env:
+            yield Finding(
+                rule_id="IMG401",
+                severity=ERROR,
+                category="image",
+                message=(
+                    "no TPU client stack: install jax[tpu]/libtpu (or set "
+                    "TPU_*/JAX_PLATFORMS env) or the container silently "
+                    "runs on CPU while the slice reservation burns"
+                ),
+                artifact=path,
+            )
+
+
+@rule(
+    "IMG402",
+    severity=ERROR,
+    category="image",
+    description="TPU workload images must not use a GPU (CUDA/ROCm) base "
+    "image",
+)
+def check_base_image(ctx: LintContext):
+    for path, instructions, tpu_flavor in _each_dockerfile(ctx):
+        base = _final_stage_base(instructions)
+        if not base:
+            yield Finding(
+                rule_id="IMG402",
+                severity=ERROR,
+                category="image",
+                message="no FROM instruction — not a buildable Dockerfile",
+                artifact=path,
+            )
+            continue
+        if tpu_flavor and _GPU_BASE.search(base):
+            yield Finding(
+                rule_id="IMG402",
+                severity=ERROR,
+                category="image",
+                message=(
+                    f"base image {base!r} is a GPU image — TPU nodes "
+                    f"expose google.com/tpu, not nvidia.com/gpu; use a "
+                    f"plain python base with jax[tpu]"
+                ),
+                artifact=path,
+            )
+
+
+@rule(
+    "IMG403",
+    severity=ERROR,
+    category="image",
+    description="Images need a CMD or ENTRYPOINT",
+)
+def check_entrypoint_present(ctx: LintContext):
+    for path, instructions, _ in _each_dockerfile(ctx):
+        if not _entrypoint_text(instructions):
+            yield Finding(
+                rule_id="IMG403",
+                severity=ERROR,
+                category="image",
+                message=(
+                    "no CMD or ENTRYPOINT — the container has nothing to "
+                    "run (dev-mode entrypoint overrides need a baseline "
+                    "process to replace)"
+                ),
+                artifact=path,
+            )
+
+
+@rule(
+    "IMG404",
+    severity=WARNING,
+    category="image",
+    description="TPU workload entrypoints should invoke python (the JAX "
+    "client)",
+)
+def check_python_entrypoint(ctx: LintContext):
+    for path, instructions, tpu_flavor in _each_dockerfile(ctx):
+        if not tpu_flavor:
+            continue
+        effective = _entrypoint_text(instructions)
+        if effective and "python" not in effective.lower():
+            yield Finding(
+                rule_id="IMG404",
+                severity=WARNING,
+                category="image",
+                message=(
+                    f"entrypoint {effective!r} does not invoke python — "
+                    f"a JAX TPU workload is driven by a python process"
+                ),
+                artifact=path,
+            )
+
+
+def lint_dockerfile(
+    text: str, path: str = "Dockerfile", tpu_flavor: bool = False
+) -> list[Finding]:
+    """Run the image rule pack over one Dockerfile's text."""
+    from .engine import run_rules
+
+    ctx = LintContext(dockerfiles=[(path, text, tpu_flavor)])
+    return run_rules(ctx, categories={"image"})

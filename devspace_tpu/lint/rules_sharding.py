@@ -1,0 +1,267 @@
+"""Static JAX sharding/mesh preflight — no TPU in the loop.
+
+The ROADMAP north-star demands helm-style shift-left for the parallelism
+layer too: today a `PartitionSpec` naming a nonexistent mesh axis or a
+non-divisible shard dim only surfaces minutes into a multi-host slice
+boot, after every pod has pulled images and libtpu has initialized. These
+rules validate the same invariants statically — abstract shapes only
+(``jax.ShapeDtypeStruct`` / ``jax.eval_shape``), so they run on the CPU
+client under ``JAX_PLATFORMS=cpu`` before anything touches a slice.
+
+Entry points:
+
+- :func:`sharding_preflight` — specs vs a declared mesh (axis names,
+  divisibility, duplicate axis use);
+- :func:`donation_preflight` — donated-buffer aliasing conflicts under
+  ``jax.eval_shape``;
+- :func:`mesh_axes_for_tpu` — resolve a ``tpu:`` config block into
+  concrete mesh axis sizes via ``parallel.mesh.mesh_shape_for``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from .engine import ERROR, WARNING, Finding, LintContext, rule, run_rules
+
+
+def _spec_entries(spec):
+    """PartitionSpec (or plain tuple) -> tuple of per-dim entries."""
+    return tuple(spec)
+
+
+def _entry_axes(entry) -> tuple:
+    """One spec dim entry (None | name | tuple-of-names) -> axis names."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def _shape_of(value) -> Optional[tuple]:
+    """Shape tuple from a ShapeDtypeStruct / array / plain tuple."""
+    shape = getattr(value, "shape", value)
+    try:
+        return tuple(int(d) for d in shape)
+    except TypeError:
+        return None
+
+
+@rule(
+    "SHD300",
+    severity=ERROR,
+    category="sharding",
+    description="The declared mesh must be buildable for the configured "
+    "topology (axis sizes multiply to the device count)",
+)
+def _mesh_buildable(ctx: LintContext):
+    # Synthesized by sharding_preflight() where the mesh is actually
+    # resolved; registered so SHD300 appears in the rule catalog.
+    return ()
+
+
+@rule(
+    "SHD301",
+    severity=ERROR,
+    category="sharding",
+    description="PartitionSpec axis names must exist in the declared mesh",
+)
+def check_axis_names(ctx: LintContext):
+    if ctx.shardings is None or ctx.mesh_axes is None:
+        return
+    known = sorted(ctx.mesh_axes)
+    for name in sorted(ctx.shardings):
+        _, spec = ctx.shardings[name]
+        for dim, entry in enumerate(_spec_entries(spec)):
+            for axis in _entry_axes(entry):
+                if axis not in ctx.mesh_axes:
+                    yield (
+                        name,
+                        f"PartitionSpec dim {dim} names mesh axis {axis!r} "
+                        f"but the mesh declares {known} — the jit would "
+                        f"fail at trace time on every worker",
+                    )
+
+
+@rule(
+    "SHD302",
+    severity=ERROR,
+    category="sharding",
+    description="Sharded dims must be divisible by the product of their "
+    "mesh axis sizes for the configured topology",
+)
+def check_divisibility(ctx: LintContext):
+    if ctx.shardings is None or ctx.mesh_axes is None:
+        return
+    for name in sorted(ctx.shardings):
+        value, spec = ctx.shardings[name]
+        shape = _shape_of(value)
+        if shape is None:
+            yield (name, f"unshapeable value {value!r}")
+            continue
+        entries = _spec_entries(spec)
+        if len(entries) > len(shape):
+            yield (
+                name,
+                f"PartitionSpec has {len(entries)} dims but the array is "
+                f"rank {len(shape)} (shape {shape})",
+            )
+            continue
+        for dim, entry in enumerate(entries):
+            axes = [a for a in _entry_axes(entry) if a in ctx.mesh_axes]
+            if not axes:
+                continue
+            shards = math.prod(ctx.mesh_axes[a] for a in axes)
+            if shards and shape[dim] % shards:
+                yield (
+                    name,
+                    f"dim {dim} of size {shape[dim]} is not divisible by "
+                    f"{'x'.join(axes)} = {shards} shards — XLA would pad "
+                    f"or reject the sharding on the slice",
+                )
+
+
+@rule(
+    "SHD303",
+    severity=ERROR,
+    category="sharding",
+    description="A mesh axis may appear at most once per PartitionSpec",
+)
+def check_duplicate_axis_use(ctx: LintContext):
+    if ctx.shardings is None:
+        return
+    for name in sorted(ctx.shardings):
+        _, spec = ctx.shardings[name]
+        seen: dict = {}
+        for dim, entry in enumerate(_spec_entries(spec)):
+            for axis in _entry_axes(entry):
+                if axis in seen:
+                    yield (
+                        name,
+                        f"mesh axis {axis!r} used by dims {seen[axis]} and "
+                        f"{dim} of the same PartitionSpec — an axis can "
+                        f"shard only one dim",
+                    )
+                else:
+                    seen[axis] = dim
+    return
+
+
+@rule(
+    "SHD304",
+    severity=WARNING,
+    category="sharding",
+    description="Donated buffers must alias an output of matching "
+    "shape+dtype or the donation is silently dropped",
+)
+def check_donation(ctx: LintContext):
+    if not ctx.donation:
+        return
+    import jax
+
+    fn = ctx.donation["fn"]
+    args = tuple(ctx.donation["args"])
+    kwargs = dict(ctx.donation.get("kwargs") or {})
+    donate = tuple(ctx.donation.get("donate_argnums") or ())
+    out = jax.eval_shape(fn, *args, **kwargs)
+    # XLA aliases a donated input to an output of identical shape+dtype;
+    # count outputs per (shape, dtype) and drain them donation by donation
+    # — a donated leaf with no remaining match is a dropped donation (the
+    # classic "Some donated buffers were not usable" warning, surfaced
+    # before any TPU allocates the duplicate).
+    available: dict = {}
+    for leaf in jax.tree_util.tree_leaves(out):
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        available[key] = available.get(key, 0) + 1
+    for argnum in donate:
+        if argnum >= len(args):
+            yield (
+                f"arg {argnum}",
+                f"donate_argnums={argnum} but the function takes only "
+                f"{len(args)} positional argument(s)",
+            )
+            continue
+        for leaf in jax.tree_util.tree_leaves(args[argnum]):
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(getattr(leaf, "dtype", "?"))
+            key = (shape, dtype)
+            if available.get(key, 0) > 0:
+                available[key] -= 1
+            else:
+                yield (
+                    f"arg {argnum}",
+                    f"donated buffer (shape {shape}, dtype {dtype}) "
+                    f"matches no remaining output — XLA will drop the "
+                    f"donation and hold both buffers live",
+                )
+
+
+def mesh_axes_for_tpu(tpu, axes: dict) -> dict:
+    """Resolve declared mesh axes (one ``-1`` wildcard allowed) against
+    the device count the tpu config implies: the topology product when a
+    topology is set, else workers x chipsPerWorker."""
+    from ..parallel.mesh import mesh_shape_for
+    from ..utils.topology import parse_topology
+
+    if tpu is not None and tpu.topology:
+        n_devices = parse_topology(tpu.topology)
+    else:
+        n_devices = ((tpu.workers if tpu else None) or 1) * (
+            (tpu.chips_per_worker if tpu else None) or 1
+        )
+    return mesh_shape_for(n_devices, dict(axes))
+
+
+def sharding_preflight(
+    mesh_axes: dict,
+    shardings: dict,
+    n_devices: Optional[int] = None,
+    tpu=None,
+) -> list[Finding]:
+    """Validate ``{name: (shape-like, PartitionSpec)}`` against a mesh.
+
+    ``mesh_axes`` may contain one ``-1`` wildcard when ``n_devices`` or
+    ``tpu`` pins the total device count; a mesh that cannot be built at
+    all is itself returned as a SHD300 finding rather than raised."""
+    axes = dict(mesh_axes)
+    try:
+        if tpu is not None:
+            axes = mesh_axes_for_tpu(tpu, axes)
+        elif n_devices is not None:
+            from ..parallel.mesh import mesh_shape_for
+
+            axes = mesh_shape_for(n_devices, axes)
+        elif any(s == -1 for s in axes.values()):
+            raise ValueError(
+                "mesh has a -1 wildcard axis but no device count to "
+                "resolve it (pass n_devices= or tpu=)"
+            )
+    except ValueError as e:
+        return [
+            Finding(
+                rule_id="SHD300",
+                severity=ERROR,
+                category="sharding",
+                message=f"mesh cannot be built: {e}",
+                location="mesh",
+            )
+        ]
+    ctx = LintContext(mesh_axes=axes, shardings=dict(shardings))
+    return run_rules(ctx, categories={"sharding"})
+
+
+def donation_preflight(fn, args, donate_argnums=(), kwargs=None) -> list[Finding]:
+    """Run the donated-buffer aliasing check under ``jax.eval_shape``:
+    ``args`` are arrays or ``jax.ShapeDtypeStruct`` pytrees — nothing is
+    computed, so this is safe on the CPU client of a TPU deployment."""
+    ctx = LintContext(
+        donation={
+            "fn": fn,
+            "args": tuple(args),
+            "kwargs": dict(kwargs or {}),
+            "donate_argnums": tuple(donate_argnums),
+        }
+    )
+    return run_rules(ctx, categories={"sharding"})

@@ -1,0 +1,215 @@
+"""Render-time TPU slice invariants as registered rules.
+
+These are the static halves of analyze's live-pod checks
+(``analyze/analyze.py:analyze_tpu_slice``): the SAME invariants checked on
+the rendered manifests, so a broken topology is caught before anything is
+applied to a cluster. Messages are kept identical to the seed
+``deploy/lint.py:lint_tpu_consistency`` so the legacy shim is behavior-
+preserving.
+"""
+
+from __future__ import annotations
+
+from ..utils.topology import parse_topology
+from .engine import ERROR, LintContext, rule
+from .rules_manifest import WORKLOAD_KINDS, containers_of
+
+
+def _tpu_active(tpu) -> bool:
+    return tpu is not None and bool(tpu.workers or tpu.topology or tpu.accelerator)
+
+
+def slice_workloads(docs: list) -> list[dict]:
+    """Workload docs that ARE the slice (TPU resources requested or worker
+    env wired), with the derived facts every TPU rule needs."""
+    out = []
+    for doc in docs:
+        if not isinstance(doc, dict) or doc.get("kind") not in WORKLOAD_KINDS:
+            continue
+        containers = containers_of(doc)
+        requests_tpu = any(
+            "google.com/tpu" in ((c.get("resources") or {}).get("limits") or {})
+            or "google.com/tpu"
+            in ((c.get("resources") or {}).get("requests") or {})
+            for c in containers
+        )
+        env_names = {
+            e.get("name")
+            for c in containers
+            for e in c.get("env") or []
+            if isinstance(e, dict)
+        }
+        if not (requests_tpu or {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"} & env_names):
+            continue
+        name = (doc.get("metadata") or {}).get("name")
+        out.append(
+            {
+                "doc": doc,
+                "label": f"{doc.get('kind')}/{name}",
+                "id": (str(doc.get("kind")), str(name)),
+                "containers": containers,
+                "env_names": env_names,
+                "requests_tpu": requests_tpu,
+            }
+        )
+    return out
+
+
+@rule(
+    "TPU201",
+    severity=ERROR,
+    category="tpu",
+    description="Topology product must equal workers x chipsPerWorker "
+    "(and parse as a product of positive integers)",
+)
+def check_topology_product(ctx: LintContext):
+    tpu = ctx.tpu
+    if not _tpu_active(tpu) or not tpu.topology:
+        return
+    workers = tpu.workers or 1
+    chips_per_worker = tpu.chips_per_worker or 1
+    try:
+        product = parse_topology(tpu.topology)
+    except ValueError as e:
+        yield ("tpu", f"unparseable topology {tpu.topology!r} ({e})")
+        return
+    if product != workers * chips_per_worker:
+        yield (
+            "tpu",
+            f"topology {tpu.topology} has {product} chips but "
+            f"workers x chipsPerWorker = {workers * chips_per_worker}",
+        )
+
+
+@rule(
+    "TPU202",
+    severity=ERROR,
+    category="tpu",
+    description="A config with a tpu block must render at least one slice "
+    "workload (google.com/tpu resources or worker env)",
+)
+def check_slice_present(ctx: LintContext):
+    if not _tpu_active(ctx.tpu):
+        return
+    if not slice_workloads(ctx.docs):
+        yield (
+            "tpu",
+            "config has a tpu block but no rendered workload requests "
+            "google.com/tpu or wires TPU_WORKER_ID/TPU_WORKER_HOSTNAMES",
+        )
+
+
+@rule(
+    "TPU203",
+    severity=ERROR,
+    category="tpu",
+    description="Slice workload replicas must equal tpu.workers, and "
+    "multi-worker slices need StatefulSet identities",
+)
+def check_slice_shape(ctx: LintContext):
+    tpu = ctx.tpu
+    if not _tpu_active(tpu):
+        return
+    workers = tpu.workers or 1
+    for w in slice_workloads(ctx.docs):
+        label = w["label"]
+        replicas = (w["doc"].get("spec") or {}).get("replicas")
+        if replicas is not None:
+            try:
+                replicas_n = int(replicas)
+            except (TypeError, ValueError):
+                yield (label, f"replicas is not an integer ({replicas!r})")
+                replicas_n = None
+            if replicas_n is not None and replicas_n != workers:
+                yield (
+                    label,
+                    f"replicas {replicas} != tpu.workers {workers} "
+                    f"(slice atomicity: every worker pod must exist)",
+                )
+        if w["doc"].get("kind") != "StatefulSet" and workers > 1:
+            yield (
+                label,
+                f"multi-worker slices need stable identities — use a "
+                f"StatefulSet (got {w['doc'].get('kind')})",
+            )
+
+
+@rule(
+    "TPU204",
+    severity=ERROR,
+    category="tpu",
+    description="Slice workloads need google.com/tpu resources and the "
+    "TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / coordinator env wiring",
+)
+def check_slice_env_wiring(ctx: LintContext):
+    tpu = ctx.tpu
+    if not _tpu_active(tpu):
+        return
+    workers = tpu.workers or 1
+    for w in slice_workloads(ctx.docs):
+        label = w["label"]
+        if not w["requests_tpu"]:
+            yield (
+                label,
+                "TPU env wired but no container requests google.com/tpu "
+                "resources",
+            )
+        for want in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"):
+            if want not in w["env_names"]:
+                yield (label, f"missing {want} env")
+        if workers > 1 and "JAX_COORDINATOR_ADDRESS" not in w["env_names"]:
+            yield (label, "multi-worker slice without JAX_COORDINATOR_ADDRESS")
+        # static hostname lists must match the worker count
+        for c in w["containers"]:
+            for e in c.get("env") or []:
+                if (
+                    isinstance(e, dict)
+                    and e.get("name") == "TPU_WORKER_HOSTNAMES"
+                    and isinstance(e.get("value"), str)
+                    and e["value"]
+                ):
+                    got = len([h for h in e["value"].split(",") if h])
+                    if got != workers:
+                        yield (
+                            label,
+                            f"TPU_WORKER_HOSTNAMES lists {got} host(s), "
+                            f"expected {workers}",
+                        )
+
+
+@rule(
+    "TPU205",
+    severity=ERROR,
+    category="tpu",
+    description="HPAs must never target a multi-host slice workload "
+    "(worker count is topology, not load)",
+)
+def check_hpa_slice_conflict(ctx: LintContext):
+    # Slice atomicity vs autoscaling: a MULTI-host slice's worker count
+    # is topology (every ordinal must exist — TPU_WORKER_HOSTNAMES is a
+    # static roster), so an HPA must never resize it. Single-host slice
+    # workloads (workers == 1) may scale: each replica is an independent
+    # model server on its own TPU host (the serving story).
+    tpu = ctx.tpu
+    if not _tpu_active(tpu):
+        return
+    workers = tpu.workers or 1
+    if workers <= 1:
+        return
+    slice_ids = {w["id"] for w in slice_workloads(ctx.docs)}
+    for doc in ctx.docs:
+        if (
+            not isinstance(doc, dict)
+            or doc.get("kind") != "HorizontalPodAutoscaler"
+        ):
+            continue
+        ref = ((doc.get("spec") or {}).get("scaleTargetRef")) or {}
+        if (str(ref.get("kind")), str(ref.get("name"))) in slice_ids:
+            yield (
+                f"HorizontalPodAutoscaler/"
+                f"{(doc.get('metadata') or {}).get('name')}",
+                f"targets multi-host slice workload {ref.get('kind')}/"
+                f"{ref.get('name')} ({workers} workers) — slice worker "
+                f"count is topology, not load; HPAs fit single-host "
+                f"serving replicas only",
+            )

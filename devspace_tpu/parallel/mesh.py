@@ -23,7 +23,9 @@ def mesh_shape_for(
     n_devices: int, axes: dict[str, int]
 ) -> dict[str, int]:
     """Resolve -1 entries: the leftover device count goes to the (single)
-    -1 axis. ``axes`` preserves insertion order."""
+    -1 axis. ``axes`` preserves insertion order. Axis sizes must be
+    integers >= 1 (or the one -1 wildcard) — a zero/negative axis would
+    otherwise surface as a baffling reshape error deep in mesh build."""
     known = 1
     wildcard = None
     for name, size in axes.items():
@@ -31,6 +33,11 @@ def mesh_shape_for(
             if wildcard is not None:
                 raise ValueError("only one mesh axis may be -1")
             wildcard = name
+        elif not isinstance(size, int) or isinstance(size, bool) or size < 1:
+            raise ValueError(
+                f"mesh axis {name!r} must be a positive integer or -1 "
+                f"(got {size!r})"
+            )
         else:
             known *= size
     if wildcard is not None:

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Self-lint: run the full rule engine over the repo's own charts.
+
+Dogfoods the preflight analyzer on everything this repo ships — the
+generator template charts (chart-tpu rendered for a 4-worker v5e slice,
+chart-cpu with defaults), the template Dockerfiles, and every
+``examples/*/chart`` — and writes one SARIF 2.1.0 log (CI uploads it to
+code scanning). Exits non-zero iff any ERROR finding fires, so a broken
+template can't merge.
+
+Usage: python scripts/lint_self.py [--output lint.sarif] [--text]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from devspace_tpu.config import latest  # noqa: E402
+from devspace_tpu.lint import (  # noqa: E402
+    ERROR,
+    count_by_severity,
+    lint_chart_findings,
+    lint_dockerfile,
+    reporters,
+)
+
+TEMPLATES = os.path.join(REPO, "devspace_tpu", "generator", "templates")
+
+
+def _tpu_context(name: str, workers: int) -> dict:
+    """The extra_context ChartDeployer wires for a slice deployment."""
+    hostnames = ",".join(f"{name}-{i}.{name}" for i in range(workers))
+    return {
+        "accelerator": "v5litepod-16" if workers > 1 else "",
+        "topology": "4x4" if workers > 1 else "",
+        "workers": workers,
+        "chipsPerWorker": 4 if workers > 1 else 1,
+        "runtimeVersion": "",
+        "workerHostnames": hostnames,
+        "coordinatorAddress": f"{name}-0.{name}:8476",
+    }
+
+
+def collect() -> list:
+    findings = []
+
+    # generator charts, rendered exactly as deploy would
+    tpu = latest.TPUConfig(
+        accelerator="v5litepod-16", topology="4x4", workers=4, chips_per_worker=4
+    )
+    findings.extend(
+        lint_chart_findings(
+            os.path.join(TEMPLATES, "chart-tpu"),
+            release_name="selflint",
+            values={"image": "registry.local/selflint:ci"},
+            tpu=tpu,
+            extra_context={
+                "images": {},
+                "pullSecrets": [],
+                "tpu": _tpu_context("selflint", 4),
+            },
+        )
+    )
+    findings.extend(
+        lint_chart_findings(
+            os.path.join(TEMPLATES, "chart-cpu"),
+            release_name="selflint",
+            values={"image": "registry.local/selflint:ci"},
+            extra_context={
+                "images": {},
+                "pullSecrets": [],
+                "tpu": _tpu_context("selflint", 1),
+            },
+        )
+    )
+
+    # template Dockerfiles (the jax one claims TPU-readiness; hold it to it)
+    df_dir = os.path.join(TEMPLATES, "dockerfiles")
+    for flavor in sorted(os.listdir(df_dir)):
+        path = os.path.join(df_dir, flavor, "Dockerfile")
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            findings.extend(
+                lint_dockerfile(
+                    fh.read(),
+                    path=os.path.relpath(path, REPO),
+                    tpu_flavor=(flavor == "jax"),
+                )
+            )
+
+    # every example chart, rendered with its own defaults
+    examples = os.path.join(REPO, "examples")
+    for name in sorted(os.listdir(examples)):
+        chart = os.path.join(examples, name, "chart")
+        if not os.path.isdir(chart):
+            continue
+        for f in lint_chart_findings(
+            chart,
+            release_name=name,
+            values={"image": f"registry.local/{name}:ci"},
+            extra_context={
+                "images": {},
+                "pullSecrets": [],
+                "tpu": _tpu_context(name, 1),
+            },
+        ):
+            f.artifact = os.path.relpath(chart, REPO)
+            findings.append(f)
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--output", "-o", help="write SARIF here (default stdout)")
+    ap.add_argument(
+        "--text", action="store_true", help="human report instead of SARIF"
+    )
+    args = ap.parse_args(argv)
+
+    findings = collect()
+    report = (
+        reporters.to_text(findings)
+        if args.text
+        else reporters.to_sarif_json(findings)
+    )
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(report + "\n")
+        counts = count_by_severity(findings)
+        print(
+            f"wrote {args.output}: {counts[ERROR]} error(s), "
+            f"{counts['warning']} warning(s)"
+        )
+    else:
+        print(report)
+    return 1 if any(f.severity == ERROR for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

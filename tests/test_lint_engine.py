@@ -1,0 +1,492 @@
+"""Rule-engine lint subsystem: registry, reporters, SARIF, CLI semantics.
+
+Pins the ISSUE acceptance criteria: exit codes (0 clean / 1 errors /
+warnings pass unless --strict), byte-stable sorted JSON, SARIF 2.1.0
+structure, the shared topology parser at both call sites, mesh axis-size
+validation, Dockerfile rules, and a zero-finding self-lint of the
+generator template charts.
+"""
+
+import json
+import os
+
+import pytest
+
+from devspace_tpu.cli.main import main
+from devspace_tpu.config import latest
+from devspace_tpu.utils import log as logutil
+from devspace_tpu.utils.fsutil import write_file
+from devspace_tpu.utils.topology import parse_topology
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TEMPLATES = os.path.join(REPO, "devspace_tpu", "generator", "templates")
+
+
+@pytest.fixture
+def project(tmp_path, monkeypatch):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    monkeypatch.chdir(proj)
+    monkeypatch.setenv("DEVSPACE_FAKE_BACKEND", str(tmp_path / "cluster"))
+    monkeypatch.setenv("DEVSPACE_NONINTERACTIVE", "1")
+    write_file(str(proj / "train.py"), "import jax\nprint('step 0')\n")
+    logutil.set_logger(logutil.StdoutLogger())
+    return proj
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_rules_well_formed():
+    from devspace_tpu.lint import REGISTRY, SEVERITIES
+
+    assert len(REGISTRY) >= 15  # manifest + tpu + sharding + image packs
+    for rule_id, r in REGISTRY.items():
+        assert r.id == rule_id
+        assert r.severity in SEVERITIES
+        assert r.category in {"manifest", "tpu", "hygiene", "sharding", "image"}
+        assert r.description
+    # every pack is represented
+    cats = {r.category for r in REGISTRY.values()}
+    assert {"manifest", "tpu", "hygiene", "sharding", "image"} <= cats
+
+
+def test_duplicate_rule_id_rejected():
+    from devspace_tpu.lint import rule
+
+    with pytest.raises(ValueError, match="duplicate"):
+
+        @rule("DS101", severity="error", category="manifest", description="x")
+        def clash(ctx):
+            return ()
+
+    with pytest.raises(ValueError, match="severity"):
+
+        @rule("ZZ999", severity="fatal", category="manifest", description="x")
+        def bad_sev(ctx):
+            return ()
+
+
+def test_findings_carry_rule_metadata():
+    from devspace_tpu.lint import ERROR, WARNING, lint_docs
+
+    docs = [
+        {"kind": "Service", "metadata": {"name": "Bad_Name"}},
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+        },
+    ]
+    findings = lint_docs(docs)
+    by_rule = {f.rule_id for f in findings}
+    assert "DS101" in by_rule  # missing apiVersion / bad name
+    assert "DS150" in by_rule  # untagged image -> hygiene warning
+    for f in findings:
+        assert f.severity == (WARNING if f.rule_id == "DS150" else ERROR)
+        assert f.message
+
+
+def test_legacy_shim_excludes_new_hygiene_warnings():
+    """validate_manifests must stay byte-compatible: the new DS150
+    untagged-image warning is engine-only."""
+    from devspace_tpu.deploy.lint import validate_manifests
+    from devspace_tpu.lint import lint_docs
+
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p"},
+        "spec": {"containers": [{"name": "c", "image": "nginx:latest"}]},
+    }
+    assert validate_manifests([pod]) == []
+    assert any(f.rule_id == "DS150" for f in lint_docs([pod]))
+
+
+# -- reporters --------------------------------------------------------------
+
+
+def _sample_findings():
+    from devspace_tpu.lint import lint_docs
+
+    return lint_docs(
+        [
+            {"kind": "Service", "metadata": {"name": "Bad_Name"}},
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web"},
+                "spec": {
+                    "template": {
+                        "spec": {"containers": [{"name": "c"}]},
+                    }
+                },
+            },
+        ],
+        artifact="chart",
+    )
+
+
+def test_json_report_stable_and_sorted():
+    from devspace_tpu.lint import reporters
+
+    findings = _sample_findings()
+    out1 = reporters.to_json(findings)
+    out2 = reporters.to_json(list(reversed(findings)))
+    assert out1 == out2  # insertion order must not leak into output
+    payload = json.loads(out1)
+    keys = [
+        (f["artifact"], f["location"], f["rule"], f["message"])
+        for f in payload["findings"]
+    ]
+    assert keys == sorted(keys)
+    assert payload["summary"]["error"] >= 2
+
+
+# The structural core of the SARIF 2.1.0 schema (oasis-tcs/sarif-spec),
+# inlined because tests run offline. Covers everything a code-scanning
+# consumer requires: version/runs, tool.driver with named rules, results
+# with ruleId + message.text + a valid level.
+SARIF_CORE_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string", "format": "uri"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "version": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                            "properties": {
+                                                "id": {"type": "string"},
+                                                "shortDescription": {
+                                                    "type": "object",
+                                                    "required": ["text"],
+                                                },
+                                                "defaultConfiguration": {
+                                                    "type": "object",
+                                                    "properties": {
+                                                        "level": {
+                                                            "enum": [
+                                                                "none",
+                                                                "note",
+                                                                "warning",
+                                                                "error",
+                                                            ]
+                                                        }
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            }
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "message"],
+                            "properties": {
+                                "ruleId": {"type": "string"},
+                                "ruleIndex": {"type": "integer", "minimum": 0},
+                                "level": {
+                                    "enum": ["none", "note", "warning", "error"]
+                                },
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                    "properties": {
+                                        "text": {"type": "string"}
+                                    },
+                                },
+                                "locations": {"type": "array"},
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def test_sarif_output_validates_against_2_1_0_schema():
+    import jsonschema
+
+    from devspace_tpu.lint import reporters
+
+    findings = _sample_findings()
+    sarif = reporters.to_sarif(findings)
+    jsonschema.validate(sarif, SARIF_CORE_SCHEMA)
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "devspace-tpu-lint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    for result in run["results"]:
+        # ruleIndex must point at the result's own rule
+        assert rule_ids[result["ruleIndex"]] == result["ruleId"]
+        assert result["message"]["text"]
+    # severities map onto SARIF's level vocabulary
+    levels = {r["level"] for r in run["results"]}
+    assert levels <= {"error", "warning", "note"}
+    # round-trips through the serializer deterministically
+    assert reporters.to_sarif_json(findings) == reporters.to_sarif_json(
+        list(reversed(findings))
+    )
+
+
+# -- shared topology parser (satellite: dedupe) -----------------------------
+
+
+def test_parse_topology_products_and_rejections():
+    assert parse_topology("4x4") == 16
+    assert parse_topology("2x2x2") == 8
+    assert parse_topology("8") == 8
+    assert parse_topology("2X4") == 8  # case-insensitive
+    for bad in ("", "2xbogus", "x4", "4x", "0x4", "-2x4", "4x0x2"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_topology_parser_at_lint_call_site():
+    from devspace_tpu.deploy.lint import lint_tpu_consistency
+
+    tpu = latest.TPUConfig(workers=2, chips_per_worker=4, topology="0x4")
+    issues = lint_tpu_consistency([], tpu)
+    assert any("unparseable topology '0x4'" in i for i in issues)
+    # a parseable-but-wrong product still reports the product mismatch
+    tpu = latest.TPUConfig(workers=2, chips_per_worker=1, topology="4x4")
+    issues = lint_tpu_consistency([], tpu)
+    assert any("topology 4x4 has 16" in i for i in issues)
+
+
+def test_topology_parser_at_analyze_call_site(tmp_path):
+    from devspace_tpu.analyze.analyze import analyze_tpu_slice
+    from devspace_tpu.kube.fake import FakeCluster
+
+    fc = FakeCluster(str(tmp_path))
+    env = {"TPU_WORKER_HOSTNAMES": "app-0.app,app-1.app"}
+    for i in range(2):
+        fc.add_pod(f"app-{i}", labels={"app": "app"}, worker_id=i, env=env)
+    cfg = latest.new()
+    cfg.deployments = [latest.DeploymentConfig(name="app")]
+    cfg.tpu = latest.TPUConfig(workers=2, topology="0x4", chips_per_worker=4)
+    probs = analyze_tpu_slice(fc, cfg, "default")
+    assert any("unparseable topology '0x4'" in p for p in probs)
+
+
+# -- mesh axis validation (satellite) ---------------------------------------
+
+
+def test_mesh_shape_for_rejects_bad_axis_sizes():
+    from devspace_tpu.parallel.mesh import mesh_shape_for
+
+    # boundary: 1 is the smallest legal size; -1 is the wildcard
+    assert mesh_shape_for(8, {"data": 8, "model": 1}) == {"data": 8, "model": 1}
+    assert mesh_shape_for(8, {"data": -1}) == {"data": 8}
+    for bad in (0, -2, 2.0, "2", True):
+        with pytest.raises(ValueError, match="positive integer"):
+            mesh_shape_for(8, {"data": bad, "model": 2})
+    with pytest.raises(ValueError, match="only one"):
+        mesh_shape_for(8, {"data": -1, "model": -1})
+
+
+# -- Dockerfile rules -------------------------------------------------------
+
+
+def test_dockerfile_rules_tpu_flavor():
+    from devspace_tpu.lint import lint_dockerfile
+
+    fs = lint_dockerfile(
+        "FROM nvidia/cuda:12.2.0-runtime\nRUN pip install torch\n",
+        tpu_flavor=True,
+    )
+    ids = {f.rule_id for f in fs}
+    assert {"IMG401", "IMG402", "IMG403"} <= ids
+
+    # continuation-aware: the jax[tpu] install spans lines
+    ok = (
+        "FROM python:3.12-slim\n"
+        "RUN pip install \\\n"
+        '    "jax[tpu]" -f https://storage.googleapis.com/libtpu-releases/index.html\n'
+        'CMD ["python", "train.py"]\n'
+    )
+    assert lint_dockerfile(ok, tpu_flavor=True) == []
+
+    # non-python entrypoint on a TPU image is a warning, not an error
+    fs = lint_dockerfile(
+        "FROM python:3.12-slim\nENV JAX_PLATFORMS=tpu\nCMD [\"./run.sh\"]\n",
+        tpu_flavor=True,
+    )
+    assert [f.rule_id for f in fs] == ["IMG404"]
+    assert all(f.severity == "warning" for f in fs)
+
+    # cpu flavor: only the universal checks apply
+    assert lint_dockerfile("FROM golang:1.22\nCMD [\"/app\"]\n") == []
+    fs = lint_dockerfile("FROM golang:1.22\n")
+    assert [f.rule_id for f in fs] == ["IMG403"]
+
+
+def test_template_dockerfiles_lint_clean():
+    df_dir = os.path.join(TEMPLATES, "dockerfiles")
+    from devspace_tpu.lint import lint_dockerfile
+
+    for flavor in sorted(os.listdir(df_dir)):
+        path = os.path.join(df_dir, flavor, "Dockerfile")
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            findings = lint_dockerfile(
+                fh.read(), path=path, tpu_flavor=(flavor == "jax")
+            )
+        assert findings == [], f"{flavor}: {[f.message for f in findings]}"
+
+
+# -- self-lint: generator charts render clean (satellite) -------------------
+
+
+def _chart_tpu_context(name, workers):
+    hostnames = ",".join(f"{name}-{i}.{name}" for i in range(workers))
+    return {
+        "accelerator": "v5litepod-16" if workers > 1 else "",
+        "topology": "4x4" if workers > 1 else "",
+        "workers": workers,
+        "chipsPerWorker": 4 if workers > 1 else 1,
+        "runtimeVersion": "",
+        "workerHostnames": hostnames,
+        "coordinatorAddress": f"{name}-0.{name}:8476",
+    }
+
+
+def test_self_lint_template_charts_zero_findings():
+    from devspace_tpu.lint import lint_chart_findings
+
+    tpu = latest.TPUConfig(
+        accelerator="v5litepod-16", topology="4x4", workers=4, chips_per_worker=4
+    )
+    findings = lint_chart_findings(
+        os.path.join(TEMPLATES, "chart-tpu"),
+        release_name="self",
+        values={"image": "registry.local/self:ci"},
+        tpu=tpu,
+        extra_context={
+            "images": {},
+            "pullSecrets": [],
+            "tpu": _chart_tpu_context("self", 4),
+        },
+    )
+    assert findings == [], [f.legacy() for f in findings]
+    findings = lint_chart_findings(
+        os.path.join(TEMPLATES, "chart-cpu"),
+        release_name="self",
+        values={"image": "registry.local/self:ci"},
+        extra_context={
+            "images": {},
+            "pullSecrets": [],
+            "tpu": _chart_tpu_context("self", 1),
+        },
+    )
+    assert findings == [], [f.legacy() for f in findings]
+
+
+def test_lint_self_script_passes():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint_self.py")],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    # the repo's own charts must produce no ERROR results
+    for run in sarif["runs"]:
+        assert all(r["level"] != "error" for r in run["results"])
+
+
+# -- CLI exit-code semantics (satellite) ------------------------------------
+
+
+def test_cli_exit_codes_clean_errors_warnings_strict(project, tmp_path):
+    assert main(["init"]) == 0
+    assert main(["lint"]) == 0
+
+    # warning-only chart: untagged image -> 0 normally, 1 under --strict
+    chart = tmp_path / "warnchart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "chart.yaml").write_text("name: warnchart\nversion: 0.1.0\n")
+    (chart / "templates" / "p.yaml").write_text(
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\nspec:\n"
+        "  containers:\n  - name: c\n    image: nginx\n"
+    )
+    assert main(["lint", "--chart", str(chart)]) == 0
+    assert main(["lint", "--chart", str(chart), "--strict"]) == 1
+
+    # error chart: 1 regardless of strictness
+    (chart / "templates" / "p.yaml").write_text(
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: UPPER\n"
+    )
+    assert main(["lint", "--chart", str(chart)]) == 1
+
+
+def test_cli_json_output_is_stable(project, capsys):
+    assert main(["init"]) == 0
+    assert main(["lint", "--format", "json"]) == 0
+    out1 = capsys.readouterr().out
+    assert main(["lint", "--format", "json"]) == 0
+    out2 = capsys.readouterr().out
+    assert out1 == out2
+    payload = json.loads(out1)
+    assert payload["summary"] == {"error": 0, "info": 0, "warning": 0}
+
+
+def test_cli_sarif_format(project, capsys):
+    import jsonschema
+
+    assert main(["init"]) == 0
+    # break the chart so results are non-empty
+    sts = project / "chart" / "templates" / "statefulset.yaml"
+    text = sts.read_text().replace("${{ tpu.workers }}", "1")
+    sts.write_text(text)
+    assert main(["lint", "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    jsonschema.validate(sarif, SARIF_CORE_SCHEMA)
+    results = sarif["runs"][0]["results"]
+    assert any(r["ruleId"] == "TPU203" for r in results)
+
+
+# -- deploy preflight -------------------------------------------------------
+
+
+def test_deploy_preflight_blocks_errors_and_skip_lint_bypasses(project):
+    assert main(["init"]) == 0
+    sts = project / "chart" / "templates" / "statefulset.yaml"
+    text = sts.read_text().replace("${{ tpu.workers }}", "1")
+    sts.write_text(text)
+    assert main(["deploy"]) == 1  # lint errors abort before anything applies
+    assert main(["deploy", "--skip-lint"]) == 0

@@ -1,0 +1,167 @@
+"""Static JAX sharding/mesh preflight — acceptance criteria pins.
+
+Everything runs under JAX_PLATFORMS=cpu (conftest forces it, with 8
+virtual host devices): the checks are abstract-shape only, which is the
+point — they catch slice-killing sharding bugs before a TPU exists.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from devspace_tpu.config import latest
+from devspace_tpu.lint import (
+    donation_preflight,
+    mesh_axes_for_tpu,
+    sharding_preflight,
+)
+
+
+def test_nonexistent_mesh_axis_is_error():
+    findings = sharding_preflight(
+        {"data": 4, "model": 2},
+        {"w": (jax.ShapeDtypeStruct((8, 8), jnp.float32), P(None, "tensor"))},
+    )
+    assert [f.rule_id for f in findings] == ["SHD301"]
+    assert findings[0].severity == "error"
+    assert "'tensor'" in findings[0].message
+    assert "['data', 'model']" in findings[0].message
+
+
+def test_non_divisible_shard_dim_is_error():
+    findings = sharding_preflight(
+        {"data": 4, "model": 2},
+        {"acts": ((16, 7), P("data", "model"))},
+    )
+    assert [f.rule_id for f in findings] == ["SHD302"]
+    assert "dim 1 of size 7" in findings[0].message
+    assert "model = 2" in findings[0].message
+    # divisible passes, including multi-axis dims whose product divides
+    assert (
+        sharding_preflight(
+            {"data": 4, "model": 2},
+            {
+                "acts": ((16, 8), P("data", "model")),
+                "fsdp": ((32,), P(("data", "model"),)),
+            },
+        )
+        == []
+    )
+
+
+def test_multi_axis_dim_uses_product_of_sizes():
+    findings = sharding_preflight(
+        {"data": 4, "model": 2},
+        {"fsdp": ((12,), P(("data", "model"),))},
+    )
+    assert [f.rule_id for f in findings] == ["SHD302"]
+    assert "dataxmodel = 8" in findings[0].message
+
+
+def test_duplicate_axis_in_spec_is_error():
+    findings = sharding_preflight(
+        {"data": 4, "model": 2},
+        {"dup": ((8, 8), P("data", "data"))},
+    )
+    assert [f.rule_id for f in findings] == ["SHD303"]
+
+
+def test_spec_rank_exceeding_array_rank_is_error():
+    findings = sharding_preflight(
+        {"data": 4},
+        {"v": ((8,), P("data", None))},
+    )
+    assert [f.rule_id for f in findings] == ["SHD302"]
+    assert "rank 1" in findings[0].message
+
+
+def test_unbuildable_mesh_is_single_finding():
+    findings = sharding_preflight({"data": 3, "model": 2}, {}, n_devices=8)
+    assert [f.rule_id for f in findings] == ["SHD300"]
+    assert "mesh cannot be built" in findings[0].message
+    # an unresolvable wildcard is also SHD300, not a crash
+    findings = sharding_preflight({"data": -1}, {})
+    assert [f.rule_id for f in findings] == ["SHD300"]
+
+
+def test_mesh_axes_for_tpu_resolves_wildcard_from_topology():
+    tpu = latest.TPUConfig(topology="4x4", workers=4, chips_per_worker=4)
+    assert mesh_axes_for_tpu(tpu, {"data": -1, "model": 2}) == {
+        "data": 8,
+        "model": 2,
+    }
+    # no topology: workers x chipsPerWorker is the device count
+    tpu = latest.TPUConfig(workers=2, chips_per_worker=4)
+    assert mesh_axes_for_tpu(tpu, {"data": -1}) == {"data": 8}
+
+
+def test_preflight_against_tpu_config_end_to_end():
+    """The ISSUE scenario: PartitionSpecs validated against the mesh a
+    tpu: config block implies, statically."""
+    tpu = latest.TPUConfig(
+        accelerator="v5litepod-16", topology="4x4", workers=4, chips_per_worker=4
+    )
+    findings = sharding_preflight(
+        {"data": -1, "model": 2},
+        {
+            "embed": ((48, 512), P("data", "model")),
+            "bad_axis": ((16, 16), P("expert", None)),
+            "bad_dim": ((10, 16), P("data", None)),
+        },
+        tpu=tpu,
+    )
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule_id, []).append(f.location)
+    assert by_rule == {"SHD301": ["bad_axis"], "SHD302": ["bad_dim"]}
+
+
+def test_donation_aliasing_under_eval_shape():
+    def step(params, batch):
+        new_params = jax.tree_util.tree_map(lambda p: p * 2.0, params)
+        loss = jnp.sum(batch)
+        return new_params, loss
+
+    params = {
+        "w": jax.ShapeDtypeStruct((128, 128), jnp.float32),
+        "b": jax.ShapeDtypeStruct((128,), jnp.float32),
+    }
+    batch = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    # params -> new params: every donated leaf aliases an output
+    assert donation_preflight(step, (params, batch), donate_argnums=(0,)) == []
+    # batch has no (32, 128) output to alias: dropped donation -> warning
+    findings = donation_preflight(step, (params, batch), donate_argnums=(0, 1))
+    assert [f.rule_id for f in findings] == ["SHD304"]
+    assert findings[0].severity == "warning"
+    assert "(32, 128)" in findings[0].message
+
+
+def test_donation_dtype_mismatch_not_aliased():
+    def cast(x):
+        return x.astype(jnp.bfloat16)
+
+    x = jax.ShapeDtypeStruct((64,), jnp.float32)
+    findings = donation_preflight(cast, (x,), donate_argnums=(0,))
+    assert [f.rule_id for f in findings] == ["SHD304"]
+
+
+def test_donation_out_of_range_argnum():
+    findings = donation_preflight(
+        lambda x: x, (jax.ShapeDtypeStruct((4,), jnp.float32),), donate_argnums=(3,)
+    )
+    assert [f.rule_id for f in findings] == ["SHD304"]
+    assert "only 1 positional" in findings[0].message
+
+
+def test_works_with_concrete_arrays_and_flags_unshapeable():
+    assert (
+        sharding_preflight(
+            {"data": 2},
+            {"x": (jnp.zeros((4, 4)), P("data", None))},
+        )
+        == []
+    )
+    # junk instead of a shape is reported, not crashed on
+    findings = sharding_preflight({"data": 2}, {"x": (object(), P("data"))})
+    assert [f.rule_id for f in findings] == ["SHD302"]
+    assert "unshapeable" in findings[0].message
