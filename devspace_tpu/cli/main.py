@@ -1422,6 +1422,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--no-portforwarding", action="store_true")
     sp.add_argument("--no-terminal", action="store_true")
     sp.add_argument("--verbose-sync", action="store_true")
+    sp.add_argument(
+        "--restart-policy",
+        choices=["always", "on-failure", "never"],
+        default="on-failure",
+        help="supervisor restart policy for dev-session services "
+        "(sync, port-forward): restart on any exit, only on failure, "
+        "or never (default: on-failure)",
+    )
     sp.set_defaults(fn=cmd_dev)
 
     sp = sub.add_parser("deploy", help="build and deploy (CI mode)")

@@ -14,6 +14,7 @@ from ..builder.images import build_all
 from ..builder.registry import init_registries
 from ..config import latest
 from ..deploy.manifests import deploy_all
+from ..resilience.supervisor import SessionSupervisor, SupervisorEvent
 from ..services import sessions as svc
 from ..services.watch import GlobWatcher
 from ..utils import log as logutil
@@ -94,6 +95,7 @@ class DevLoop:
         self.forwarders: list = []
         self.watcher: Optional[GlobWatcher] = None
         self.logmux: Optional[svc.LogMux] = None
+        self.supervisor: Optional[SessionSupervisor] = None
         self.reload_requested = threading.Event()
         self.reload_count = 0  # cumulative reloads (event is cleared fast)
         self.stop_requested = threading.Event()
@@ -101,12 +103,25 @@ class DevLoop:
 
     # -- services ----------------------------------------------------------
     def start_services(self) -> None:
+        """Start dev services under the session supervisor: port-forwards
+        are non-critical (a dead forwarder is restarted; an unrestartable
+        one degrades the session but sync continues), sync is critical (an
+        unrestartable sync session ends the dev loop — it owns slice-state
+        correctness)."""
         config = self.ctx.config
         backend = self.ctx.backend
-        if not getattr(self.args, "no_portforwarding", False):
+        self.supervisor = SessionSupervisor(
+            restart=getattr(self.args, "restart_policy", None) or "on-failure",
+            logger=self.log,
+            on_event=self._on_supervisor_event,
+        )
+
+        def make_forwarders() -> list:
             with span("portforward.start"):
                 self.forwarders = svc.start_port_forwarding(backend, config, self.log)
-        if not getattr(self.args, "no_sync", False):
+            return self.forwarders
+
+        def make_sync() -> list:
             with span("sync.start") as s:
                 self.sync_sessions = svc.start_sync(
                     backend,
@@ -116,6 +131,37 @@ class DevLoop:
                     verbose=getattr(self.args, "verbose_sync", False),
                 )
                 s["sessions"] = len(self.sync_sessions)
+            return self.sync_sessions
+
+        if not getattr(self.args, "no_portforwarding", False):
+            self.supervisor.add(
+                "ports",
+                make_forwarders,
+                probe=lambda fws: all(fw.alive() for fw in fws),
+                stop=lambda fws: [fw.stop() for fw in fws],
+                failure=lambda fws: next(
+                    (
+                        f"forwarder for ports {fw.ports} died"
+                        for fw in fws
+                        if not fw.alive()
+                    ),
+                    "port-forward liveness probe failed",
+                ),
+                critical=False,
+            )
+        if not getattr(self.args, "no_sync", False):
+            self.supervisor.add(
+                "sync",
+                make_sync,
+                probe=lambda sessions: all(s.alive() for s in sessions),
+                stop=lambda sessions: [s.stop() for s in sessions],
+                failure=lambda sessions: next(
+                    (str(s.error) for s in sessions if s.error is not None),
+                    "sync liveness probe failed",
+                ),
+                critical=True,
+            )
+        self.supervisor.start()
         auto_reload = (config.dev.auto_reload if config.dev else None)
         if auto_reload and not auto_reload.disabled and auto_reload.paths:
             self.watcher = GlobWatcher(
@@ -131,8 +177,19 @@ class DevLoop:
         self.reload_count += 1
         self.reload_requested.set()
 
+    def _on_supervisor_event(self, ev: SupervisorEvent) -> None:
+        """Live status line: any state change prints session health
+        (the `dev` status surface the supervisor owns)."""
+        if ev.kind in ("died", "restarted", "degraded", "failed") and self.supervisor:
+            self.log.info("[dev] %s", self.supervisor.status_line())
+
     def stop_services(self) -> None:
         self.services_ready.clear()
+        if self.supervisor:
+            self.supervisor.stop()  # stops registered handles via their stop fns
+            self.supervisor = None
+        # Direct stops stay as a belt-and-braces fallback (idempotent; also
+        # covers services that never made it under the supervisor).
         for session in self.sync_sessions:
             session.stop()
         for fw in self.forwarders:
@@ -216,10 +273,18 @@ class DevLoop:
         while not self.stop_requested.is_set():
             if self.reload_requested.is_set():
                 return None
-            fatal = [s for s in self.sync_sessions if s.error is not None]
-            if fatal:
-                self.log.error("[dev] sync failed: %s", fatal[0].error)
-                return 1
+            if self.supervisor is not None:
+                # The supervisor owns failure semantics: a dying sync
+                # session is restarted under the policy first; only an
+                # exhausted critical service ends the loop.
+                if self.supervisor.failed.is_set():
+                    self.log.error("[dev] %s", self.supervisor.error)
+                    return 1
+            else:
+                fatal = [s for s in self.sync_sessions if s.error is not None]
+                if fatal:
+                    self.log.error("[dev] sync failed: %s", fatal[0].error)
+                    return 1
             time.sleep(0.2)
         return 0
 

@@ -19,6 +19,7 @@ import subprocess
 import threading
 from typing import Iterator, Optional
 
+from ..resilience.chaos import ByteBudgetStream, ChaosConfig
 from ..utils import log as logutil
 from .client import CRITICAL_STATUS, Pod, get_pod_status, selector_string
 from .portforward import LocalPortTunnel, PortForwarder
@@ -47,6 +48,12 @@ class FakeCluster:
         self.pod_logs: dict[tuple[str, str], list[bytes]] = {}
         self.pod_ports: dict[tuple[str, str, int], int] = {}  # remote -> local
         self.connections = ConnectionTracker()
+        # Fault injection (docs/resilience.md): tests attach a ChaosConfig
+        # and the hooks below consult it before each operation. None = off.
+        self.chaos: Optional[ChaosConfig] = None
+        # Live exec streams per pod, so kill_pod can tear down a pod's
+        # connections the way a real pod deletion severs its exec sessions.
+        self._pod_procs: dict[tuple[str, str], list[RemoteProcess]] = {}
         # Persistence lets separate CLI invocations (deploy, then dev) share
         # one fake cluster, like a real API server would.
         self._persist = persist
@@ -177,6 +184,30 @@ class FakeCluster:
         by a local server on local_port (test fixture for port-forward)."""
         self.pod_ports[(namespace, pod, remote_port)] = local_port
 
+    def kill_pod(self, name: str, namespace: str = "default") -> int:
+        """Chaos fixture: the pod vanishes mid-session — it is removed from
+        the store AND every live exec stream into it is torn down (a real
+        deletion severs exec/attach connections the same way). Returns the
+        number of streams killed. Re-create with add_pod to simulate a
+        controller bringing the worker back."""
+        with self._lock:
+            self.pods.pop((namespace, name), None)
+            procs = self._pod_procs.pop((namespace, name), [])
+        killed = 0
+        for p in procs:
+            try:
+                if p.poll() is None:
+                    p.terminate()
+                    killed += 1
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        self._save_state()
+        return killed
+
+    def _chaos(self, op: str, **context) -> None:
+        if self.chaos is not None:
+            self.chaos.before(op, **context)
+
     # -- namespaces --------------------------------------------------------
     def ensure_namespace(self, namespace: str) -> None:
         with self._lock:
@@ -244,6 +275,7 @@ class FakeCluster:
     ) -> list[Pod]:
         import time
 
+        self._chaos("slice_workers", selector=selector_string(label_selector))
         deadline = time.monotonic() + timeout
         while True:
             pods = self.list_pods(namespace, label_selector)
@@ -278,8 +310,17 @@ class FakeCluster:
             else (namespace or self.default_namespace)
         )
         self._require_pod(name, ns)
+        self._chaos("exec_stream", pod=name)
         workdir = self.pod_dir(name, ns)
-        return self.connections.track(SubprocessRemoteProcess(command, cwd=workdir))
+        proc: RemoteProcess = SubprocessRemoteProcess(command, cwd=workdir)
+        budget = self.chaos.stream_budget("exec_stream") if self.chaos else None
+        if budget is not None:
+            proc = ByteBudgetStream(proc, budget)
+        with self._lock:
+            live = self._pod_procs.setdefault((ns, name), [])
+            live[:] = [p for p in live if ConnectionTracker._alive(p)]
+            live.append(proc)
+        return self.connections.track(proc)
 
     def _require_pod(self, name: str, ns: str) -> None:
         with self._lock:
@@ -301,6 +342,7 @@ class FakeCluster:
             else (namespace or self.default_namespace)
         )
         self._require_pod(name, ns)
+        self._chaos("exec_buffered", pod=name)
         proc = subprocess.run(
             command,
             cwd=self.pod_dir(name, ns),
@@ -345,9 +387,11 @@ class FakeCluster:
             if isinstance(pod, Pod)
             else (namespace or self.default_namespace)
         )
+        self._chaos("logs", pod=name)
         lines = self.pod_logs.get((ns, name), [])
         if tail is not None:
-            lines = lines[-tail:]
+            # tail=0 means "no history" (k8s tailLines=0), not lines[-0:]
+            lines = lines[-tail:] if tail > 0 else []
         yield from lines
 
     def portforward(
@@ -365,6 +409,7 @@ class FakeCluster:
         )
 
         def dial(remote: int):
+            self._chaos("portforward_dial", pod=name, port=remote)
             target = self.pod_ports.get((ns, name, remote))
             if target is None:
                 raise ConnectionRefusedError(

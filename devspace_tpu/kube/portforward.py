@@ -15,12 +15,26 @@ import struct
 import threading
 from typing import Callable, Optional
 
+from ..resilience.policy import RetryPolicy
 from .transport import KubeTransport
 from .websocket import OP_CLOSE, WebSocketError
 
 
 class PortForwardError(Exception):
     pass
+
+
+def _default_dial_policy() -> RetryPolicy:
+    """A tunnel dial races pod restarts and transient API-server blips;
+    three quick attempts ride out both without the user noticing."""
+    return RetryPolicy(
+        max_attempts=3,
+        base_delay=0.05,
+        max_delay=0.5,
+        jitter=0.0,
+        seed=0,
+        retry_on=(OSError, WebSocketError),
+    )
 
 
 class PortForwarder:
@@ -32,17 +46,21 @@ class PortForwarder:
         ports: list[tuple[int, int]],
         bind_address: str = "127.0.0.1",
         logger=None,
+        dial_policy: Optional[RetryPolicy] = None,
     ):
         """``dial(remote_port)`` returns a connected bidirectional stream
         object with send(bytes)/recv()->bytes/close() — implementation
-        detail of the backend (WebSocket tunnel or fake local socket)."""
+        detail of the backend (WebSocket tunnel or fake local socket).
+        Each accepted local connection dials under ``dial_policy``."""
         self.dial = dial
         self.ports = ports
         self.bind_address = bind_address
         self.log = logger
+        self.dial_policy = dial_policy or _default_dial_policy()
         self._listeners: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._stopped = threading.Event()
+        self._dead = threading.Event()  # a listener died while not stopped
         self.ready = threading.Event()
         self.local_ports: list[int] = []
 
@@ -72,14 +90,37 @@ class PortForwarder:
             try:
                 conn, _ = lsock.accept()
             except OSError:
+                if not self._stopped.is_set():
+                    # listener socket died under us — the forwarder is no
+                    # longer serving; surface it to liveness probes
+                    self._dead.set()
                 return
             threading.Thread(
                 target=self._handle, args=(conn, remote), daemon=True
             ).start()
 
+    def alive(self) -> bool:
+        """Liveness probe for the session supervisor: started, not stopped
+        and every listener still accepting."""
+        return (
+            self.ready.is_set()
+            and not self._stopped.is_set()
+            and not self._dead.is_set()
+        )
+
     def _handle(self, conn: socket.socket, remote: int) -> None:
         try:
-            tunnel = self.dial(remote)
+            tunnel = self.dial_policy.execute(
+                self.dial,
+                remote,
+                describe=f"port-forward dial :{remote}",
+                reraise=True,
+                on_retry=lambda attempt, exc, delay: self.log
+                and self.log.warn(
+                    "port-forward dial to %d failed (attempt %d), retrying "
+                    "in %.2fs: %s", remote, attempt, delay, exc,
+                ),
+            )
         except Exception as e:  # noqa: BLE001 — surface any dial failure
             if self.log:
                 self.log.error("port-forward dial to %d failed: %s", remote, e)

@@ -19,6 +19,7 @@ import tempfile
 import urllib.parse
 from typing import Any, Iterator, Optional
 
+from ..resilience.policy import RetryPolicy
 from . import websocket as ws
 from .kubeconfig import ClusterInfo, ContextInfo, KubeConfig, UserInfo
 
@@ -29,6 +30,21 @@ class ApiError(Exception):
         self.status = status
         self.reason = reason
         self.body = body
+
+
+def _default_connect_policy() -> RetryPolicy:
+    """Transport-level transient-failure policy: connection refused/reset
+    and malformed responses from an API server mid-restart are retried with
+    short exponential backoff; HTTP-level errors (ApiError) are never — the
+    server answered, the answer stands."""
+    return RetryPolicy(
+        max_attempts=3,
+        base_delay=0.2,
+        max_delay=2.0,
+        jitter=0.2,
+        seed=0,
+        retry_on=(OSError, http.client.HTTPException),
+    )
 
 
 class KubeTransport:
@@ -43,7 +59,9 @@ class KubeTransport:
         insecure: bool = False,
         default_namespace: str = "default",
         context_name: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
+        self.retry_policy = retry_policy or _default_connect_policy()
         u = urllib.parse.urlparse(server)
         if u.scheme not in ("https", "http"):
             raise ValueError(f"unsupported API server scheme: {server}")
@@ -162,6 +180,36 @@ class KubeTransport:
         content_type: str = "application/json",
         timeout: float = 30.0,
     ) -> Any:
+        """One API request, retried under ``retry_policy`` when safe:
+        idempotent methods (GET/HEAD/DELETE/PUT) retry any transport error;
+        non-idempotent ones (POST/PATCH) retry only ConnectionRefusedError —
+        with the connection refused, nothing reached the server."""
+        if method.upper() in ("GET", "HEAD", "DELETE", "PUT"):
+            return self.retry_policy.execute(
+                self._request_once,
+                method, path, query, body, content_type, timeout,
+                describe=f"{method} {path}",
+                reraise=True,
+            )
+        try:
+            return self._request_once(method, path, query, body, content_type, timeout)
+        except ConnectionRefusedError:
+            return self.retry_policy.execute(
+                self._request_once,
+                method, path, query, body, content_type, timeout,
+                describe=f"{method} {path}",
+                reraise=True,
+            )
+
+    def _request_once(
+        self,
+        method: str,
+        path: str,
+        query: Optional[dict[str, str]] = None,
+        body: Any = None,
+        content_type: str = "application/json",
+        timeout: float = 30.0,
+    ) -> Any:
         conn_cls = http.client.HTTPSConnection if self.scheme == "https" else http.client.HTTPConnection
         kwargs = {"timeout": timeout}
         if self.scheme == "https":
@@ -232,6 +280,22 @@ class KubeTransport:
 
     # -- WebSocket upgrade -------------------------------------------------
     def connect_websocket(
+        self,
+        path: str,
+        query: Optional[list[tuple[str, str]]] = None,
+        subprotocols: Optional[list[str]] = None,
+        timeout: float = 30.0,
+    ) -> ws.WebSocket:
+        """Dial + upgrade, retried under ``retry_policy``: until the
+        handshake completes no stream state exists, so a redial is free."""
+        return self.retry_policy.execute(
+            self._connect_websocket_once,
+            path, query, subprotocols, timeout,
+            describe=f"websocket {path}",
+            reraise=True,
+        )
+
+    def _connect_websocket_once(
         self,
         path: str,
         query: Optional[list[tuple[str, str]]] = None,

@@ -1,0 +1,58 @@
+"""Resilience subsystem: retry/backoff policies, circuit breakers,
+session supervision and deterministic fault injection.
+
+The multi-host dev loop (SURVEY §7) keeps many long-lived streams alive at
+once — N upstream sync shells, a downstream poll shell, port-forward
+listeners, a worker-prefixed log mux. Before this package each of them
+handled failure its own way: a hand-rolled consecutive-error counter in the
+downstream poll, fixed readiness timeouts in port-forwarding, nothing at all
+for the log mux. This package centralizes the failure-handling vocabulary:
+
+- :mod:`.policy` — :class:`RetryPolicy` (exponential backoff + deterministic
+  jitter, attempt/deadline bounds), :class:`CircuitBreaker`, and
+  :class:`IdleBackoff` for poll loops.
+- :mod:`.supervisor` — :class:`SessionSupervisor`, one owner for every
+  dev-session service lifecycle: liveness probes, restart-under-policy,
+  graded degradation (non-critical service lost → keep going and emit a
+  status event; critical service lost → escalate).
+- :mod:`.chaos` — :class:`ChaosConfig`, the deterministic fault-injection
+  hook consumed by the fake backend so every recovery path is exercised in
+  tier-1 tests with no real cluster (docs/resilience.md).
+"""
+
+from .chaos import ChaosConfig, ChaosError
+from .policy import (
+    CircuitBreaker,
+    CircuitOpenError,
+    IdleBackoff,
+    RetryExhausted,
+    RetryPolicy,
+    retry,
+)
+from .supervisor import (
+    RESTART_ALWAYS,
+    RESTART_NEVER,
+    RESTART_ON_FAILURE,
+    ServiceState,
+    SessionSupervisor,
+    SupervisorEvent,
+    format_ready_timeout,
+)
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosError",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "IdleBackoff",
+    "RetryExhausted",
+    "RetryPolicy",
+    "retry",
+    "RESTART_ALWAYS",
+    "RESTART_NEVER",
+    "RESTART_ON_FAILURE",
+    "ServiceState",
+    "SessionSupervisor",
+    "SupervisorEvent",
+    "format_ready_timeout",
+]

@@ -14,10 +14,24 @@ from typing import Optional
 
 from ..config import latest
 from ..config.loader import get_default_namespace, get_selector
+from ..resilience.policy import RetryPolicy
 
 
 class SelectorError(Exception):
     pass
+
+
+def _default_resolve_policy() -> RetryPolicy:
+    """Pod resolution races pod churn (a slice restarting mid-resolve shows
+    up as a transient connection error); retry those, never config errors."""
+    return RetryPolicy(
+        max_attempts=3,
+        base_delay=0.2,
+        max_delay=2.0,
+        jitter=0.2,
+        seed=0,
+        retry_on=(ConnectionError, TimeoutError),
+    )
 
 
 def resolve_selector(
@@ -64,14 +78,24 @@ def resolve_workers(
     namespace: Optional[str] = None,
     container: Optional[str] = None,
     timeout: float = 120.0,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> tuple[list, str, Optional[str]]:
     """Resolve the ordered slice worker pods for a service.
-    Returns (workers, namespace, container_name)."""
+    Returns (workers, namespace, container_name). Transient backend errors
+    (connection drops, timeouts) are retried under ``retry_policy``;
+    configuration errors (:class:`SelectorError`) are not."""
     ns, labels, cont = resolve_selector(
         config, selector_name, label_selector, namespace, container
     )
     expected = config.tpu.workers if config.tpu and config.tpu.workers else None
-    workers = backend.slice_workers(
-        labels, namespace=ns, expected=expected, timeout=timeout
+    policy = retry_policy or _default_resolve_policy()
+    workers = policy.execute(
+        backend.slice_workers,
+        labels,
+        namespace=ns,
+        expected=expected,
+        timeout=timeout,
+        describe=f"resolve workers for {labels!r}",
+        reraise=True,
     )
     return workers, ns, cont

@@ -11,10 +11,13 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from typing import Optional
 
 from ..config import latest
 from ..kube.portforward import PortForwarder
+from ..resilience.policy import IdleBackoff, RetryPolicy
+from ..resilience.supervisor import format_ready_timeout
 from ..sync.session import SyncOptions, SyncSession
 from ..utils import log as logutil
 from .selectors import resolve_workers
@@ -120,9 +123,20 @@ def start_port_forwarding(
                 bind_address=(pc.port_mappings or [latest.PortMapping()])[0].bind_address
                 or "127.0.0.1",
             )
+            started = time.monotonic()
             fw.start()
             if not fw.ready.wait(PORTFORWARD_READY_TIMEOUT):
-                raise TimeoutError(f"port forward to {pod.name} not ready")
+                # Same message shape as the supervisor's restart reporting
+                # (resilience.supervisor.format_ready_timeout) so operators
+                # grep one format for every not-ready-in-time failure.
+                raise TimeoutError(
+                    format_ready_timeout(
+                        "port-forward",
+                        f"worker {pod.name}",
+                        time.monotonic() - started,
+                        "ports " + ",".join(f"{lp}->{rp}" for lp, rp in ports),
+                    )
+                )
             forwarders.append(fw)
             for (lp, rp) in ports:
                 log.done(
@@ -158,9 +172,27 @@ def worker_prefix(pod) -> str:
     return f"[worker-{wid}] " if wid is not None else f"[{getattr(pod, 'name', pod)}] "
 
 
+def _default_logmux_policy() -> RetryPolicy:
+    """Log streams drop whenever a pod restarts or the API server rotates
+    the connection; reconnecting is cheap and the tail dedups nothing, so
+    be generous with attempts but cap the wait. No jitter: one policy is
+    shared across per-pod follow threads, and jitter would draw from the
+    shared RNG in thread order — nondeterministic under chaos tests."""
+    return RetryPolicy(
+        max_attempts=5,
+        base_delay=0.2,
+        max_delay=5.0,
+        jitter=0.0,
+        seed=0,
+        retry_on=(Exception,),
+    )
+
+
 class LogMux:
     """Worker-prefixed log streaming across the slice
-    (replaces the reference's single-pod log follow)."""
+    (replaces the reference's single-pod log follow). A dropped follow
+    stream reconnects under ``retry_policy``; data on the new stream
+    refills the attempt budget."""
 
     def __init__(
         self,
@@ -171,6 +203,7 @@ class LogMux:
         tail: Optional[int] = 100,
         out=None,
         logger: Optional[logutil.Logger] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         self.backend = backend
         self.workers = workers
@@ -179,9 +212,12 @@ class LogMux:
         self.tail = tail
         self.out = out or sys.stdout
         self.log = logger or logutil.get_logger()
+        self.retry_policy = retry_policy or _default_logmux_policy()
         self._threads: list[threading.Thread] = []
         self._stopped = threading.Event()
         self._write_lock = threading.Lock()
+        # observability for tests/status: reconnects per pod name
+        self.reconnects: dict[str, int] = {}
 
     def _prefix(self, pod) -> str:
         return worker_prefix(pod)
@@ -206,23 +242,53 @@ class LogMux:
 
     def _follow_one(self, pod) -> None:
         prefix = self._prefix(pod)
-        try:
-            for line in self.backend.logs(
-                pod,
-                namespace=self.namespace,
-                container=self.container,
-                tail=self.tail,
-                follow=True,
-            ):
+        name = getattr(pod, "name", str(pod))
+        delays = self.retry_policy.delays()
+        # Once lines have been printed, reconnects re-tail with 0 so a
+        # mid-flight drop does not replay them; until then keep the
+        # configured tail — the history was never shown.
+        tail = self.tail
+        got_any = False
+        while not self._stopped.is_set():
+            got_data = False
+            try:
+                for line in self.backend.logs(
+                    pod,
+                    namespace=self.namespace,
+                    container=self.container,
+                    tail=tail,
+                    follow=True,
+                ):
+                    if self._stopped.is_set():
+                        return
+                    got_data = got_any = True
+                    with self._write_lock:
+                        self.out.write(prefix + line.decode("utf-8", "replace") + "\n")
+                        if hasattr(self.out, "flush"):
+                            self.out.flush()
+                return  # clean EOF — pod gone for good, nothing to chase
+            except Exception as e:  # noqa: BLE001 — stream dropped mid-follow
                 if self._stopped.is_set():
                     return
-                with self._write_lock:
-                    self.out.write(prefix + line.decode("utf-8", "replace") + "\n")
-                    if hasattr(self.out, "flush"):
-                        self.out.flush()
-        except Exception as e:  # noqa: BLE001 — log stream ended
-            if not self._stopped.is_set():
-                self.log.warn("[logs] stream from %s ended: %s", pod.name, e)
+                if got_data:
+                    delays = self.retry_policy.delays()  # progress refills budget
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    self.log.warn(
+                        "[logs] stream from %s ended (reconnect budget "
+                        "exhausted): %s", name, e,
+                    )
+                    return
+                self.reconnects[name] = self.reconnects.get(name, 0) + 1
+                self.log.warn(
+                    "[logs] stream from %s dropped, reconnecting in %.1fs: %s",
+                    name, delay, e,
+                )
+                if got_any:
+                    tail = 0
+                if self._stopped.wait(delay):
+                    return
 
     def stop(self) -> None:
         self._stopped.set()
@@ -258,25 +324,33 @@ def _pump_terminal(proc, stdin=None, stdout=None, tty: bool = False) -> int:
     stdout = stdout or sys.stdout
     stop = threading.Event()
 
+    # Idle-adaptive polling (was a fixed timeout=0.2, waking 5x/s on
+    # streams quiet for hours): the wait doubles while idle up to 1s and
+    # snaps back to 50ms the moment data arrives, so interactive latency
+    # is unchanged but an idle session barely wakes.
     def pump_out():
+        idle = IdleBackoff(initial=0.05, maximum=1.0)
         while not stop.is_set():
             try:
-                data = proc.stdout.read_available(timeout=0.2)
+                data = proc.stdout.read_available(timeout=idle.next_wait())
             except Exception:  # noqa: BLE001 — stream closed
                 return
             if data:
+                idle.reset()
                 text = data.decode("utf-8", "replace")
                 stdout.write(text)
                 if hasattr(stdout, "flush"):
                     stdout.flush()
 
     def pump_err():
+        idle = IdleBackoff(initial=0.05, maximum=1.0)
         while not stop.is_set():
             try:
-                data = proc.stderr.read_available(timeout=0.2)
+                data = proc.stderr.read_available(timeout=idle.next_wait())
             except Exception:  # noqa: BLE001
                 return
             if data:
+                idle.reset()
                 sys.stderr.write(data.decode("utf-8", "replace"))
                 sys.stderr.flush()
 

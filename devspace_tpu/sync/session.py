@@ -27,6 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..resilience.policy import RetryPolicy
 from ..utils import log as logutil
 from ..utils.ignoreutil import IgnoreMatcher
 from .file_info import FileInformation, local_file_information
@@ -496,7 +497,7 @@ class SyncSession:
                 i for i in range(len(self.workers)) if i not in self.worker_errors
             ]
 
-    def _mark_worker_failed(self, i: int, exc: BaseException) -> None:
+    def _mark_worker_failed(self, i: int, exc: Exception) -> None:
         with self._workers_lock:
             if i in self.worker_errors:
                 return
@@ -567,7 +568,7 @@ class SyncSession:
                 len(need),
             )
             return True
-        except BaseException:  # noqa: BLE001 — revive is best-effort
+        except Exception:  # noqa: BLE001 — revive is best-effort
             return False
 
     def _fan_out(self, op, what: str) -> list[int]:
@@ -586,14 +587,14 @@ class SyncSession:
             try:
                 f.result()
                 ok.append(i)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 err = e
                 if self._try_revive(i):
                     try:
                         op(i)
                         ok.append(i)
                         continue
-                    except BaseException as e2:  # noqa: BLE001
+                    except Exception as e2:  # noqa: BLE001
                         err = e2
                 self._mark_worker_failed(i, err)
         with self._workers_lock:
@@ -653,6 +654,19 @@ class SyncSession:
         )
 
     # -- downstream --------------------------------------------------------
+    def _poll_policy(self) -> RetryPolicy:
+        """Downstream-poll failure budget (reference: downstream.go:199-203
+        retries after 4s; we back off 2x up to the same 4s cap). Five
+        consecutive failures — or a dead shell — end the session."""
+        return RetryPolicy(
+            max_attempts=5,
+            base_delay=min(4.0, self.opts.downstream_interval * 2),
+            max_delay=4.0,
+            multiplier=2.0,
+            seed=0,
+            retry_on=(SyncError, TimeoutError, ConnectionError),
+        )
+
     def _downstream_loop(self) -> None:
         """Poll worker 0; act only after `stable_polls` identical snapshots
         (reference: downstream.go mainLoop 105-134)."""
@@ -660,26 +674,33 @@ class SyncSession:
         previous: Optional[dict[str, FileInformation]] = None
         stable = 0
         applied_version: Optional[frozenset] = None
-        consecutive_errors = 0
+        poll_policy = self._poll_policy()
+        poll_delays = poll_policy.delays()
         try:
             while not self._stopped.is_set():
-                time.sleep(self.opts.downstream_interval)
-                if self._stopped.is_set():
+                if self._stopped.wait(self.opts.downstream_interval):
                     return
                 try:
                     snap = self._down_shell.snapshot(
                         self._remote_dir(self.workers[0])
                     )
-                    consecutive_errors = 0
-                except (SyncError, TimeoutError) as e:
-                    # Transient poll failures retry (reference:
-                    # downstream.go:199-203 retries after 4s); only a dead
-                    # shell or persistent failure is fatal.
-                    consecutive_errors += 1
-                    if consecutive_errors >= 5 or not self._down_shell.alive():
+                    poll_delays = poll_policy.delays()  # success resets budget
+                except poll_policy.retry_on as e:
+                    # Transient poll failures retry under the policy; only a
+                    # dead shell or an exhausted budget is fatal.
+                    if not self._down_shell.alive():
                         raise
-                    self.log.warn("[sync] downstream poll failed, retrying: %s", e)
-                    time.sleep(min(4.0, self.opts.downstream_interval * 2))
+                    try:
+                        delay = next(poll_delays)
+                    except StopIteration:
+                        raise e from None
+                    self.log.warn(
+                        "[sync] downstream poll failed, retrying in %.1fs: %s",
+                        delay,
+                        e,
+                    )
+                    if self._stopped.wait(delay):
+                        return
                     continue
                 snap = {
                     rel: info
@@ -847,7 +868,7 @@ class SyncSession:
                     continue
                 try:
                     repaired = self._verify_worker(i)
-                except BaseException as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     # verify shares _fan_out's graded semantics: revive
                     # once, else quarantine; never fatal for a mirror.
                     if self._stopped.is_set():
@@ -915,6 +936,12 @@ class SyncSession:
         return len(need) + len(extra)
 
     # -- health / status surfaces -------------------------------------------
+    def alive(self) -> bool:
+        """Liveness probe for the session supervisor: running with no
+        fatal error. Quarantined mirror workers do NOT make the session
+        dead — that is the graded-degradation contract."""
+        return not self._stopped.is_set() and self.error is None
+
     def worker_health(self) -> list[dict]:
         """Per-worker live state for `status sync` (VERDICT round-1
         missing #2: per-worker health view)."""

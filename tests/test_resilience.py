@@ -1,0 +1,235 @@
+"""Unit tests for the resilience primitives (policy/breaker/backoff).
+
+All time is injected (fake sleep/clock), so these run in milliseconds and
+every delay schedule asserted here is exact — the same determinism the
+chaos suite depends on (scripts/chaos_check.py).
+"""
+
+import pytest
+
+from devspace_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    IdleBackoff,
+    RetryExhausted,
+    RetryPolicy,
+    format_ready_timeout,
+    retry,
+)
+
+
+# -- RetryPolicy.delays ----------------------------------------------------
+def test_delays_schedule_exponential_and_capped():
+    p = RetryPolicy(max_attempts=5, base_delay=1.0, max_delay=4.0, multiplier=2.0)
+    assert list(p.delays()) == [1.0, 2.0, 4.0, 4.0]
+
+
+def test_delays_count_is_attempts_minus_one():
+    assert len(list(RetryPolicy(max_attempts=1).delays())) == 0
+    assert len(list(RetryPolicy(max_attempts=3).delays())) == 2
+
+
+def test_delays_jitter_deterministic_with_seed():
+    a = list(RetryPolicy(max_attempts=6, jitter=0.5, seed=42).delays())
+    b = list(RetryPolicy(max_attempts=6, jitter=0.5, seed=42).delays())
+    c = list(RetryPolicy(max_attempts=6, jitter=0.5, seed=7).delays())
+    assert a == b
+    assert a != c
+    # jitter only shaves, never grows, and never goes negative
+    full = list(RetryPolicy(max_attempts=6, jitter=0.0).delays())
+    assert all(0.0 <= j <= f for j, f in zip(a, full))
+
+
+# -- RetryPolicy.execute ---------------------------------------------------
+def test_execute_success_first_try_no_sleep():
+    sleeps = []
+    p = RetryPolicy(max_attempts=3)
+    out = p.execute(lambda: "ok", sleep=sleeps.append)
+    assert out == "ok"
+    assert sleeps == []
+
+
+def test_execute_retries_then_succeeds():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "recovered"
+
+    p = RetryPolicy(max_attempts=4, base_delay=0.5, multiplier=2.0)
+    assert p.execute(flaky, sleep=sleeps.append) == "recovered"
+    assert calls["n"] == 3
+    assert sleeps == [0.5, 1.0]
+
+
+def test_execute_exhausts_raises_retry_exhausted():
+    p = RetryPolicy(max_attempts=3, base_delay=0.1)
+    with pytest.raises(RetryExhausted) as exc:
+        p.execute(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            describe="dial",
+            sleep=lambda d: None,
+        )
+    assert exc.value.attempts == 3
+    assert isinstance(exc.value.last, OSError)
+    assert "dial" in str(exc.value)
+
+
+def test_execute_reraise_preserves_original_exception_type():
+    p = RetryPolicy(max_attempts=2, base_delay=0.1)
+
+    def fail():
+        raise ConnectionRefusedError("refused")
+
+    with pytest.raises(ConnectionRefusedError):
+        p.execute(fail, reraise=True, sleep=lambda d: None)
+
+
+def test_execute_non_matching_exception_propagates_immediately():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("config, not transport")
+
+    p = RetryPolicy(max_attempts=5, retry_on=(OSError,))
+    with pytest.raises(ValueError):
+        p.execute(boom, sleep=lambda d: None)
+    assert calls["n"] == 1
+
+
+def test_execute_deadline_stops_before_sleeping_past_it():
+    # fake clock: each attempt costs 1s; deadline 2.5s allows attempt 1,
+    # one 1s backoff and attempt 2 — then the next wait would cross it.
+    now = {"t": 0.0}
+
+    def clock():
+        return now["t"]
+
+    def fail():
+        now["t"] += 1.0
+        raise OSError("down")
+
+    def sleep(d):
+        now["t"] += d
+
+    p = RetryPolicy(max_attempts=10, base_delay=1.0, multiplier=1.0, deadline=2.5)
+    with pytest.raises(RetryExhausted) as exc:
+        p.execute(fail, sleep=sleep, clock=clock)
+    assert "deadline" in str(exc.value)
+    assert exc.value.attempts == 2
+
+
+def test_execute_on_retry_hook_sees_attempt_exc_delay():
+    seen = []
+    p = RetryPolicy(max_attempts=3, base_delay=0.5, multiplier=2.0)
+    with pytest.raises(RetryExhausted):
+        p.execute(
+            lambda: (_ for _ in ()).throw(OSError("x")),
+            on_retry=lambda a, e, d: seen.append((a, type(e).__name__, d)),
+            sleep=lambda d: None,
+        )
+    assert seen == [(1, "OSError", 0.5), (2, "OSError", 1.0)]
+
+
+def test_retry_decorator():
+    calls = {"n": 0}
+
+    @retry(RetryPolicy(max_attempts=3, base_delay=0.0))
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise OSError("once")
+        return 7
+
+    assert flaky() == 7
+    assert calls["n"] == 2
+
+
+# -- CircuitBreaker --------------------------------------------------------
+def test_circuit_opens_after_threshold():
+    cb = CircuitBreaker(failure_threshold=3, reset_timeout=30.0)
+    assert cb.state == CircuitBreaker.CLOSED
+    for _ in range(3):
+        assert cb.allow()
+        cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+    assert not cb.allow()
+
+
+def test_circuit_success_resets_failure_count():
+    cb = CircuitBreaker(failure_threshold=2)
+    cb.record_failure()
+    cb.record_success()
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_circuit_half_open_probe_success_closes():
+    now = {"t": 0.0}
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout=10.0, clock=lambda: now["t"])
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+    now["t"] = 10.0
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_circuit_half_open_probe_failure_reopens_and_restarts_timer():
+    now = {"t": 0.0}
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout=10.0, clock=lambda: now["t"])
+    cb.record_failure()
+    now["t"] = 10.0
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+    now["t"] = 15.0  # only 5s since re-open: still open
+    assert not cb.allow()
+    now["t"] = 20.0
+    assert cb.allow()
+
+
+def test_circuit_call_raises_circuit_open_without_running():
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout=100.0, name="api")
+    with pytest.raises(RuntimeError):
+        cb.call(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+
+    with pytest.raises(CircuitOpenError) as exc:
+        cb.call(fn)
+    assert calls["n"] == 0
+    assert "api" in str(exc.value)
+
+
+# -- IdleBackoff -----------------------------------------------------------
+def test_idle_backoff_grows_and_caps():
+    ib = IdleBackoff(initial=0.05, maximum=0.4, multiplier=2.0)
+    assert [ib.next_wait() for _ in range(5)] == [0.05, 0.1, 0.2, 0.4, 0.4]
+
+
+def test_idle_backoff_reset_snaps_back():
+    ib = IdleBackoff(initial=0.05, maximum=1.0)
+    for _ in range(4):
+        ib.next_wait()
+    ib.reset()
+    assert ib.next_wait() == 0.05
+
+
+# -- shared error formatting ----------------------------------------------
+def test_format_ready_timeout_shape():
+    msg = format_ready_timeout(
+        "port-forward", "worker w-0", 20.04, "ports 8080->80"
+    )
+    assert msg == "port-forward to worker w-0 not ready after 20.0s (ports 8080->80)"
+    assert (
+        format_ready_timeout("sync", "w-1", 1.0)
+        == "sync to w-1 not ready after 1.0s"
+    )

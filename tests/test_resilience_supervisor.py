@@ -1,0 +1,293 @@
+"""SessionSupervisor state-machine tests.
+
+Services are tiny in-memory fakes with the same surface the dev loop's real
+services expose (``alive()``/``stop()``/``error``); restart policies use
+zero delays so every test settles in well under a second.
+"""
+
+import time
+
+import pytest
+
+from devspace_tpu.resilience import (
+    RESTART_ALWAYS,
+    RESTART_NEVER,
+    RESTART_ON_FAILURE,
+    RetryPolicy,
+    ServiceState,
+    SessionSupervisor,
+)
+
+
+def wait_for(cond, timeout=5.0, interval=0.01, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class FakeService:
+    def __init__(self):
+        self._alive = True
+        self.error = None
+        self.stops = 0
+
+    def alive(self):
+        return self._alive
+
+    def stop(self):
+        self.stops += 1
+        self._alive = False
+
+    def die(self, error=None):
+        self.error = error
+        self._alive = False
+
+
+def fast_policy(attempts=3):
+    return RetryPolicy(max_attempts=attempts, base_delay=0.01, max_delay=0.02)
+
+
+def make_supervisor(restart=RESTART_ON_FAILURE, **kw):
+    return SessionSupervisor(
+        restart=restart, poll_interval=0.01, default_policy=fast_policy(), **kw
+    )
+
+
+def svc_row(sup, name):
+    return next(r for r in sup.status() if r["service"] == name)
+
+
+def test_invalid_restart_policy_rejected():
+    with pytest.raises(ValueError):
+        SessionSupervisor(restart="sometimes")
+
+
+def test_factory_failure_at_startup_is_loud():
+    sup = make_supervisor()
+    sup.add("bad", factory=lambda: (_ for _ in ()).throw(RuntimeError("no pods")))
+    with pytest.raises(RuntimeError, match="no pods"):
+        sup.start()
+
+
+def test_restart_on_failure_restarts_and_recovers():
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor()
+    sup.add("sync", factory, failure=lambda s: s.error, critical=True)
+    sup.start()
+    try:
+        made[0].die("exec stream severed")
+        wait_for(
+            lambda: svc_row(sup, "sync")["restarts"] == 1, msg="service restarted"
+        )
+        assert len(made) == 2
+        assert made[1].alive()
+        assert svc_row(sup, "sync")["state"] == ServiceState.RUNNING
+        assert svc_row(sup, "sync")["last_error"] == "exec stream severed"
+        assert not sup.failed.is_set()
+        kinds = [e.kind for e in sup.events]
+        assert "died" in kinds and "restarting" in kinds and "restarted" in kinds
+    finally:
+        sup.stop()
+
+
+def test_clean_exit_stops_under_on_failure():
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor(RESTART_ON_FAILURE)
+    sup.add("term", factory, failure=lambda s: s.error)
+    sup.start()
+    try:
+        made[0].die(error=None)  # clean exit: no error recorded
+        wait_for(
+            lambda: svc_row(sup, "term")["state"] == ServiceState.STOPPED,
+            msg="clean exit observed",
+        )
+        assert len(made) == 1  # never restarted
+        assert not sup.failed.is_set()
+    finally:
+        sup.stop()
+
+
+def test_clean_exit_restarts_under_always():
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor(RESTART_ALWAYS)
+    sup.add("term", factory, failure=lambda s: s.error)
+    sup.start()
+    try:
+        made[0].die(error=None)
+        wait_for(lambda: len(made) >= 2, msg="restart after clean exit")
+    finally:
+        sup.stop()
+
+
+def test_never_policy_escalates_without_restart():
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor(RESTART_NEVER)
+    sup.add("sync", factory, failure=lambda s: s.error, critical=True)
+    sup.start()
+    try:
+        made[0].die("gone")
+        wait_for(lambda: sup.failed.is_set(), msg="escalation")
+        assert len(made) == 1
+        assert "sync" in sup.error and "gone" in sup.error
+    finally:
+        sup.stop()
+
+
+def test_noncritical_exhausted_goes_degraded_session_continues():
+    calls = {"n": 0}
+
+    def factory():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            s = FakeService()
+            factory.first = s
+            return s
+        raise RuntimeError("bind refused")  # every restart attempt fails
+
+    sup = make_supervisor()
+    sup.add("ports", factory, failure=lambda s: s.error, critical=False,
+            policy=fast_policy(attempts=2))
+    sup.start()
+    try:
+        factory.first.die("listener died")
+        wait_for(
+            lambda: svc_row(sup, "ports")["state"] == ServiceState.DEGRADED,
+            msg="degraded",
+        )
+        # non-critical exhaustion must NOT end the session
+        assert not sup.failed.is_set()
+        assert sup.error is None
+        assert any(e.kind == "degraded" for e in sup.events)
+    finally:
+        sup.stop()
+
+
+def test_critical_exhausted_sets_failed_and_error():
+    calls = {"n": 0}
+
+    def factory():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            s = FakeService()
+            factory.first = s
+            return s
+        raise RuntimeError("no workers running")
+
+    sup = make_supervisor()
+    sup.add("sync", factory, failure=lambda s: s.error, critical=True,
+            policy=fast_policy(attempts=2))
+    sup.start()
+    try:
+        factory.first.die("authority lost")
+        wait_for(lambda: sup.failed.is_set(), msg="critical escalation")
+        assert svc_row(sup, "sync")["state"] == ServiceState.FAILED
+        assert "sync" in sup.error
+    finally:
+        sup.stop()
+
+
+def test_stop_stops_running_handles():
+    s = FakeService()
+    sup = make_supervisor()
+    sup.add("svc", lambda: s)
+    sup.start()
+    sup.stop()
+    assert s.stops == 1
+    assert svc_row(sup, "svc")["state"] == ServiceState.STOPPED
+
+
+def test_status_line_reports_health_and_restarts():
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor()
+    sup.add("ports", factory, failure=lambda s: s.error)
+    sup.add("sync", lambda: FakeService(), critical=True)
+    sup.start()
+    try:
+        assert sup.status_line() == "2/2 services up"
+        made[0].die("dropped")
+        wait_for(lambda: svc_row(sup, "ports")["restarts"] == 1, msg="restart")
+        line = sup.status_line()
+        assert "2/2 services up" in line and "1 restart(s)" in line
+    finally:
+        sup.stop()
+
+
+def test_on_event_callback_fires_and_cannot_kill_monitor():
+    events = []
+
+    def observer(ev):
+        events.append((ev.service, ev.kind))
+        raise RuntimeError("observer bug")  # must be swallowed
+
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor(on_event=observer)
+    sup.add("svc", factory, failure=lambda s: s.error)
+    sup.start()
+    try:
+        made[0].die("x")
+        wait_for(
+            lambda: ("svc", "restarted") in events, msg="events despite bad observer"
+        )
+        assert ("svc", "started") in events
+        assert ("svc", "died") in events
+    finally:
+        sup.stop()
+
+
+def test_default_probe_uses_handle_alive():
+    # no explicit probe/failure: handle.alive() + handle.error drive it
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor()
+    sup.add("svc", factory)
+    sup.start()
+    try:
+        made[0].die("imploded")
+        wait_for(lambda: svc_row(sup, "svc")["restarts"] == 1, msg="restart")
+        assert svc_row(sup, "svc")["last_error"] == "imploded"
+    finally:
+        sup.stop()

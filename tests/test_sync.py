@@ -412,6 +412,38 @@ def test_authority_worker_loss_is_fatal(tmp_path, cluster, monkeypatch):
         session.stop()
 
 
+def test_all_workers_lost_is_fatal(tmp_path, cluster, monkeypatch):
+    """Losing EVERY worker permanently must stop the session with an error
+    (pins the bottom of the graded-failure ladder: mirror lost -> continue;
+    worker 0 or all lost -> fatal)."""
+    session, local, workers = make_session(tmp_path, cluster, n_workers=2)
+    write_file(str(local / "a.txt"), "1")
+    session.start()
+    try:
+        for w in workers:
+            wait_for(
+                lambda w=w: os.path.exists(remote_path(cluster, w, "a.txt")),
+                msg="initial fan-out",
+            )
+        # Every pod vanishes: all shells die and no revive can succeed.
+        monkeypatch.setattr(
+            cluster,
+            "exec_stream",
+            lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("slice gone")),
+        )
+        for shell in list(session._shells):
+            shell.close()
+        write_file(str(local / "b.txt"), "2")
+        wait_for(lambda: session.error is not None, msg="fatal session error")
+        # worker 0 is among the lost, so the authority message wins
+        assert "worker 0" in str(session.error) or "every worker" in str(
+            session.error
+        )
+        assert session._stopped.is_set()
+    finally:
+        session.stop()
+
+
 def test_concurrent_bidirectional_stress(tmp_path, cluster):
     """Many files changing on both sides at once must converge with no
     lost updates (reference test matrix analogue: TestNormalSync's
