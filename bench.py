@@ -486,6 +486,67 @@ def bench_initial_sync() -> float:
     return elapsed
 
 
+def bench_sync_fanout() -> tuple[float, float]:
+    """Median edit->all-workers latency on a 16-worker fake slice carrying
+    a 10k-file tree (seconds), plus the matching 1-worker median. The
+    ISSUE 4 acceptance gate: with the content-addressed artifact cache +
+    pipelined per-worker queues the 16-worker number must stay within 2x
+    of the 1-worker number (a serial tar-per-worker broadcast degrades
+    roughly linearly in slice size)."""
+    import os
+    import random
+    import tempfile
+
+    from devspace_tpu.kube.fake import FakeCluster
+    from devspace_tpu.sync.session import SyncOptions, SyncSession
+    from devspace_tpu.utils import log as logutil
+    from devspace_tpu.utils.fsutil import write_file
+
+    logutil.set_logger(logutil.DiscardLogger())
+
+    def run(n_workers: int) -> float:
+        tmp = tempfile.mkdtemp()
+        fc = FakeCluster(os.path.join(tmp, "cluster"))
+        local = os.path.join(tmp, "local")
+        rng = random.Random(0)
+        for d in range(100):
+            dd = os.path.join(local, f"pkg{d:03d}")
+            os.makedirs(dd)
+            for f in range(100):
+                with open(os.path.join(dd, f"m{f:03d}.py"), "wb") as fh:
+                    fh.write(b"x" * rng.randrange(100, 400))
+        workers = [fc.add_pod(f"w-{i}", worker_id=i) for i in range(n_workers)]
+        session = SyncSession(
+            fc, workers, SyncOptions(local_path=local, container_path="/app")
+        )
+        session.start()
+        lat = []
+        try:
+            if not session.initial_sync_done.wait(300):
+                raise TimeoutError("initial sync did not finish")
+            for trial in range(5):
+                marker = f"edit {trial}"
+                path = os.path.join(local, "pkg000", "m000.py")
+                t0 = time.monotonic()
+                write_file(path, marker)
+                fut = time.time() + 2 + trial
+                os.utime(path, (fut, fut))
+                _wait_mirrored(
+                    fc,
+                    workers,
+                    "pkg000/m000.py",
+                    content=marker,
+                    session=session,
+                )
+                lat.append(time.monotonic() - t0)
+        finally:
+            session.stop()
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    return run(16), run(1)
+
+
 def bench_dev_loop() -> float:
     """Cold `devspace-tpu dev` end-to-end latency on the fake backend:
     init -> build -> deploy -> all services (sync fan-out + watcher) live
@@ -752,6 +813,16 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         notes.append(f"initial sync bench failed: {e}")
         log(f"[bench] initial sync bench failed: {e}")
+    fanout_16_s = fanout_1_s = None
+    try:
+        fanout_16_s, fanout_1_s = bench_sync_fanout()
+        log(
+            f"[bench] sync fan-out (10k-file tree): edit->16-workers "
+            f"{fanout_16_s * 1000:.0f}ms vs 1-worker {fanout_1_s * 1000:.0f}ms"
+        )
+    except Exception as e:  # noqa: BLE001
+        notes.append(f"sync fan-out bench failed: {e}")
+        log(f"[bench] sync fan-out bench failed: {e}")
     dev_s = None
     try:
         dev_s = bench_dev_loop()
@@ -835,6 +906,14 @@ def main() -> int:
         else None,
         "initial_sync_10k_files_s": round(initial_sync_s, 2)
         if initial_sync_s
+        else None,
+        # pipelined fan-out (ISSUE 4): edit->slice latency must not scale
+        # with worker count — acceptance is 16-worker within 2x of 1-worker
+        "sync_fanout_16_workers_ms": round(fanout_16_s * 1000, 0)
+        if fanout_16_s
+        else None,
+        "sync_fanout_1_worker_ms": round(fanout_1_s * 1000, 0)
+        if fanout_1_s
         else None,
         "dev_loop_cold_s": round(dev_s, 2) if dev_s else None,
         # host-side radix prefix-cache costs (10k entries, 4k prompts)

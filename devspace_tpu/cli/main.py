@@ -1423,6 +1423,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--no-terminal", action="store_true")
     sp.add_argument("--verbose-sync", action="store_true")
     sp.add_argument(
+        "--sync-digest",
+        choices=["on", "off"],
+        default="on",
+        help="content-digest gating for sync uploads: unchanged bytes "
+        "(touch/checkout) become a remote mtime fix instead of a "
+        "re-upload (default: on)",
+    )
+    sp.add_argument(
         "--restart-policy",
         choices=["always", "on-failure", "never"],
         default="on-failure",

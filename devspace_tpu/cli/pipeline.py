@@ -129,6 +129,7 @@ class DevLoop:
                     base_dir=self.ctx.root,
                     logger=self.log,
                     verbose=getattr(self.args, "verbose_sync", False),
+                    digest=getattr(self.args, "sync_digest", "on") != "off",
                 )
                 s["sessions"] = len(self.sync_sessions)
             return self.sync_sessions

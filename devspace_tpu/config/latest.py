@@ -167,6 +167,11 @@ class SyncConfig:
     # Seconds between drift-verification passes over mirror workers
     # (0 disables; default 30).
     verify_interval: Optional[float] = None
+    # Content-digest gating: metadata-only changes (touch/checkout with
+    # unchanged bytes) become remote mtime fixes instead of re-uploads.
+    # Default on; set false for trees where hashing costs more than the
+    # transfers it avoids.
+    digest: Optional[bool] = None
 
 
 @dataclass

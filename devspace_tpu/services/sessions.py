@@ -35,6 +35,7 @@ def start_sync(
     base_dir: str = ".",
     logger: Optional[logutil.Logger] = None,
     verbose: bool = False,
+    digest: bool = True,
 ) -> list[SyncSession]:
     """Start every dev.sync entry (reference: services/sync.go StartSync)."""
     import os
@@ -70,6 +71,9 @@ def start_sync(
             verify_interval=(
                 sc.verify_interval if sc.verify_interval is not None else 30.0
             ),
+            # off if either the CLI (--sync-digest off) or this sync
+            # entry (digest: false) disables it
+            digest_gating=digest and sc.digest is not False,
             status_path=os.path.join(
                 base_dir, ".devspace", "logs", "sync-status.json"
             ),

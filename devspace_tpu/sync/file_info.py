@@ -9,9 +9,11 @@ protocol agentless: any TPU-VM/container image with sh+find+stat+tar works.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shlex
 import stat as statmod
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -28,6 +30,12 @@ class FileInformation:
     remote_mode: Optional[int] = None  # permission bits to preserve on re-upload
     remote_uid: Optional[int] = None
     remote_gid: Optional[int] = None
+    # Content digest (blake2b-128 hex) of the file bytes, when known.
+    # NOT part of the wire protocol (remote stat can't produce it) and NOT
+    # part of same_as: it rides the index so the upstream can tell a
+    # touch/checkout that changed only metadata from a real content change
+    # and answer with a metadata-only fix instead of a re-upload.
+    digest: Optional[str] = None
 
     def same_as(self, other: "FileInformation") -> bool:
         """Equality for change detection: mtime+size for files, existence
@@ -35,6 +43,55 @@ class FileInformation:
         if self.is_directory or other.is_directory:
             return self.is_directory == other.is_directory
         return self.size == other.size and self.mtime == other.mtime
+
+
+def file_digest(path: str) -> Optional[str]:
+    """blake2b-128 hex of a file's bytes; None when unreadable (raced with
+    a delete). 128 bits keeps index entries small while collisions stay
+    out of reach for any realistic tree."""
+    h = hashlib.blake2b(digest_size=16)
+    try:
+        with open(path, "rb") as fh:
+            while True:
+                chunk = fh.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+class DigestCache:
+    """Local ``(relpath, size, mtime) -> digest`` memo so the upstream can
+    digest-gate without re-hashing unchanged files. The key embeds the
+    stat identity, so a real content change (new size/mtime) misses
+    naturally; a touch that bumps only the mtime also misses — that single
+    re-hash is exactly the gating check. Entries are dropped wholesale
+    past ``max_entries`` (the map is a memo, not a correctness surface)."""
+
+    def __init__(self, max_entries: int = 200_000):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._map: dict[tuple[str, int, int], str] = {}
+
+    def digest(self, root: str, info: FileInformation) -> Optional[str]:
+        """Digest of the file ``info`` names, re-hashing only on stat
+        change. Returns None for directories or unreadable files."""
+        if info.is_directory:
+            return None
+        key = (info.name, info.size, info.mtime)
+        with self._lock:
+            cached = self._map.get(key)
+        if cached is not None:
+            return cached
+        d = file_digest(os.path.join(root, info.name.replace("/", os.sep)))
+        if d is not None:
+            with self._lock:
+                if len(self._map) >= self.max_entries:
+                    self._map.clear()
+                self._map[key] = d
+        return d
 
 
 def local_file_information(root: str, relpath: str) -> Optional[FileInformation]:

@@ -28,6 +28,19 @@ class FileIndex:
 
     def set(self, info: FileInformation) -> None:
         with self._lock:
+            # Digest preservation: callers that re-index an unchanged file
+            # from a digest-less source (a remote snapshot, a stat walk)
+            # must not erase a digest the upload path already paid to
+            # compute — keep it while the stat identity still matches.
+            if info.digest is None and not info.is_directory:
+                old = self._map.get(info.name)
+                if (
+                    old is not None
+                    and old.digest is not None
+                    and old.size == info.size
+                    and old.mtime == info.mtime
+                ):
+                    info.digest = old.digest
             self._map[info.name] = info
             # Ensure parent dirs exist in the index (reference:
             # CreateDirInFileMap).

@@ -30,8 +30,10 @@ from typing import Optional
 from ..resilience.policy import RetryPolicy
 from ..utils import log as logutil
 from ..utils.ignoreutil import IgnoreMatcher
-from .file_info import FileInformation, local_file_information
+from .artifacts import TarArtifactCache
+from .file_info import DigestCache, FileInformation, local_file_information
 from .index import FileIndex
+from .pipeline import UploadPipeline
 from .shell import RateLimiter, RemoteShell, SyncError, build_tar, extract_tar
 from .watcher import Watcher, new_watcher
 
@@ -153,6 +155,14 @@ class SyncOptions:
     # (reference reconstructs per-session status from sync.log regexes,
     # cmd/status/sync.go:56-110; we publish structured state instead).
     status_path: Optional[str] = None
+    # Content-digest gating: a change whose bytes are unchanged (touch,
+    # branch checkout round-trip) becomes a remote metadata-only fix
+    # instead of a re-upload. Off switch for pathological trees where
+    # hashing on every event costs more than the transfer it avoids.
+    digest_gating: bool = True
+    # Per-worker send-queue depth for the pipelined upstream (bounds
+    # in-flight artifacts per worker at depth x UPLOAD_BATCH_BYTES).
+    pipeline_depth: int = 3
 
 
 class SyncSession:
@@ -180,9 +190,15 @@ class SyncSession:
         self._last_remote_lock = threading.Lock()
         self._up_limiter = RateLimiter(options.upload_limit_kbs)
         self._down_limiter = RateLimiter(options.download_limit_kbs)
+        # Sized for the pipeline: its consumers occupy one thread per
+        # worker for a whole _apply_uploads call, and a concurrent
+        # downstream mirror / verify repair must still find fan-out slots.
         self._pool = ThreadPoolExecutor(
-            max_workers=max(4, len(self.workers)), thread_name_prefix="sync-up"
+            max_workers=max(4, 2 * len(self.workers) + 1),
+            thread_name_prefix="sync-up",
         )
+        self.digests = DigestCache()
+        self.artifacts = TarArtifactCache()
         combined = list(options.exclude_paths)
         self.exclude = IgnoreMatcher(combined)
         self.upload_exclude = IgnoreMatcher(
@@ -199,7 +215,16 @@ class SyncSession:
             "removed_local": 0,
             "removed_remote": 0,
             "repaired": 0,
+            # perf surfaces (ISSUE 4): payload bytes actually broadcast,
+            # re-uploads avoided by digest gating (count + bytes that
+            # would have gone to each live worker), producer time spent
+            # blocked on a full per-worker send queue.
+            "bytes_sent": 0,
+            "meta_fixes": 0,
+            "bytes_saved_digest": 0,
+            "pipeline_stall_s": 0.0,
         }
+        self._stats_lock = threading.Lock()
         self.started_at: Optional[float] = None
         self.initial_sync_done = threading.Event()
         # Partial-failure state (SURVEY §7 hard part #2): workers dropped
@@ -216,6 +241,13 @@ class SyncSession:
     # -- paths -------------------------------------------------------------
     def _remote_dir(self, worker) -> str:
         return self.backend.translate_path(worker, self.opts.container_path)
+
+    # -- stats -------------------------------------------------------------
+    def _bump(self, key: str, n) -> None:
+        """Thread-safe stats increment (pipeline consumers, fan-out threads
+        and the downstream loop all write concurrently)."""
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -419,9 +451,11 @@ class SyncSession:
         return changes
 
     def _process_upstream_changes(self, changes: set[str]) -> None:
-        """Classify by stat (reference: evaluateChange) then apply."""
+        """Classify by stat (reference: evaluateChange), digest-gate
+        touch-only changes, then apply."""
         creates: list[FileInformation] = []
         removes: list[str] = []
+        meta_fixes: list[FileInformation] = []
         expanded: set[str] = set()
         for rel in sorted(changes):
             if rel in expanded:
@@ -451,9 +485,26 @@ class SyncSession:
                     li.remote_mode = old.remote_mode
                     li.remote_uid = old.remote_uid
                     li.remote_gid = old.remote_gid
+                if self.opts.digest_gating:
+                    # Hash the changed file (memoized on stat identity):
+                    # recorded on upload either way, and when the bytes
+                    # match the indexed digest the change is a touch/
+                    # checkout no-op — answer with a metadata fix.
+                    li.digest = self.digests.digest(self.opts.local_path, li)
+                    if (
+                        old is not None
+                        and not old.is_directory
+                        and old.digest is not None
+                        and li.digest is not None
+                        and li.digest == old.digest
+                    ):
+                        meta_fixes.append(li)
+                        continue
                 creates.append(li)
         if removes:
             self._apply_removes(removes)
+        if meta_fixes:
+            self._apply_meta_fixes(meta_fixes)
         if creates:
             self._apply_uploads(creates)
 
@@ -543,13 +594,18 @@ class SyncSession:
             ]
             if need:
                 for batch in _batch_entries(need):
-                    tar_bytes = build_tar(self.opts.local_path, batch)
+                    # catch-up reuses the cached artifact when the batch
+                    # matches one already built for the live workers
+                    tar_bytes = self.artifacts.get_or_build(
+                        self.opts.local_path, batch
+                    )
                     if tar_bytes:
                         shell.upload_tar(
                             self._remote_dir(worker),
                             tar_bytes,
                             limiter=self._up_limiter,
                         )
+                        self._bump("bytes_sent", len(tar_bytes))
             with self._workers_lock:
                 if self._stopped.is_set():
                     # stop() already closed every stored shell; storing now
@@ -606,38 +662,55 @@ class SyncSession:
         return ok
 
     def _apply_uploads(self, entries: list[FileInformation]) -> None:
-        """Tar once, broadcast to every live worker in parallel
-        (reference: applyCreates/uploadArchive; fan-out per SURVEY §2.2)."""
-        for batch in _batch_entries(entries):
-            tar_bytes = build_tar(self.opts.local_path, batch)
-            if not tar_bytes:
-                continue
-
-            def send(i: int) -> None:
-                self._upload_raw(self._shells[i], self.workers[i], tar_bytes)
-
-            self._fan_out(send, "upload")
-            for info in batch:
-                self.index.set(info)
-            self.stats["uploaded"] += len(batch)
-            if self.opts.verbose:
-                for info in batch:
-                    self.log.debug("[sync] upload %s", info.name)
+        """Tar once per batch (artifact cache), broadcast through the
+        bounded producer/consumer pipeline — gzip of batch N+1 overlaps
+        the network send of batch N, and each worker drains its own queue
+        (reference: applyCreates/uploadArchive; fan-out per SURVEY §2.2,
+        pipelining per ISSUE 4)."""
+        pipe = UploadPipeline(self, depth=self.opts.pipeline_depth)
+        uploaded = pipe.run(_batch_entries(entries))
+        if self.opts.verbose:
+            for info in entries:
+                self.log.debug("[sync] upload %s", info.name)
         self.log.info(
             "[sync] Uploaded %d change(s) to %d worker(s)",
-            len(entries),
+            uploaded,
             len(self._live_indices()),
+        )
+        self._publish_status()
+
+    def _apply_meta_fixes(self, entries: list[FileInformation]) -> None:
+        """Digest-gated path: bytes unchanged, only metadata moved — fix
+        the remote mtimes in place (zero payload) and re-index. Keeping
+        remote mtime == index mtime is what stops the downstream poll and
+        the verify loop from seeing these files as forever-stale."""
+        pairs = [(info.name, info.mtime) for info in entries]
+
+        def send(i: int) -> None:
+            self._shells[i].touch_paths(self._remote_dir(self.workers[i]), pairs)
+
+        self._fan_out(send, "metadata fix")
+        saved = 0
+        for info in entries:
+            self.index.set(info)
+            saved += info.size
+        self._bump("meta_fixes", len(entries))
+        self._bump("bytes_saved_digest", saved * len(self._live_indices()))
+        self.log.info(
+            "[sync] Metadata-only fix for %d file(s) (content digest unchanged)",
+            len(entries),
         )
         self._publish_status()
 
     def _upload_to(self, shell: RemoteShell, worker, entries: list[FileInformation]) -> None:
         for batch in _batch_entries(entries):
-            tar_bytes = build_tar(self.opts.local_path, batch)
+            tar_bytes = self.artifacts.get_or_build(self.opts.local_path, batch)
             if tar_bytes:
                 self._upload_raw(shell, worker, tar_bytes)
 
     def _upload_raw(self, shell: RemoteShell, worker, tar_bytes: bytes) -> None:
         shell.upload_tar(self._remote_dir(worker), tar_bytes, limiter=self._up_limiter)
+        self._bump("bytes_sent", len(tar_bytes))
 
     def _apply_removes(self, relpaths: list[str]) -> None:
         def send(i: int) -> None:
@@ -646,7 +719,7 @@ class SyncSession:
         self._fan_out(send, "remove")
         for rel in relpaths:
             self.index.remove(rel)
-        self.stats["removed_remote"] += len(relpaths)
+        self._bump("removed_remote", len(relpaths))
         self.log.info(
             "[sync] Removed %d path(s) on %d worker(s)",
             len(relpaths),
@@ -784,7 +857,7 @@ class SyncSession:
             if self.opts.verbose:
                 for info in applied:
                     self.log.debug("[sync] download %s", info.name)
-        self.stats["downloaded"] += count
+        self._bump("downloaded", count)
         self.log.info("[sync] Downloaded %d change(s)", count)
         self._publish_status()
         # Mirror downloads to non-authoritative workers so the slice stays
@@ -842,13 +915,13 @@ class SyncSession:
                     if safe:
                         shutil.rmtree(full, ignore_errors=True)
                         self.index.remove(rel)
-                        self.stats["removed_local"] += 1
+                        self._bump("removed_local", 1)
                 else:
                     li = local_file_information(self.opts.local_path, rel)
                     if li is not None and li.same_as(idx):
                         os.unlink(full)
                         self.index.remove(rel)
-                        self.stats["removed_local"] += 1
+                        self._bump("removed_local", 1)
             except OSError:
                 continue
         self.log.info("[sync] Removed %d local path(s)", len(relpaths))
@@ -882,7 +955,7 @@ class SyncSession:
                         self._worker_repairs[i] = (
                             self._worker_repairs.get(i, 0) + repaired
                         )
-                    self.stats["repaired"] += repaired
+                    self._bump("repaired", repaired)
                     self.log.warn(
                         "[sync] worker %s drifted — repaired %d path(s)",
                         getattr(self.workers[i], "name", i),
@@ -969,6 +1042,10 @@ class SyncSession:
         return out
 
     def status_snapshot(self) -> dict:
+        with self._stats_lock:
+            stats = dict(self.stats)
+        stats["pipeline_stall_s"] = round(stats.get("pipeline_stall_s", 0.0), 3)
+        stats.update(self.artifacts.stats())
         return {
             "local_path": self.opts.local_path,
             "container_path": self.opts.container_path,
@@ -976,7 +1053,7 @@ class SyncSession:
             "updated_at": time.time(),
             "running": not self._stopped.is_set(),
             "error": str(self.error) if self.error else None,
-            "stats": dict(self.stats),
+            "stats": stats,
             "workers": self.worker_health(),
         }
 

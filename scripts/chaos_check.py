@@ -24,6 +24,13 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHAOS_SEED = "0"  # fixed: policies under test derive jitter from seed=0
 
+# Modules that MUST contribute chaos-marked tests for the gate to mean
+# anything: a renamed marker or module would otherwise silently shrink the
+# suite to zero relevant tests while the gate stays green. test_sync_pipeline
+# carries the pipelined-upload chaos tests (worker killed mid-broadcast must
+# degrade without wedging the producer queue — ISSUE 4).
+REQUIRED_CHAOS_MODULES = ("test_resilience_chaos", "test_sync_pipeline")
+
 
 def run_chaos_suite(run_idx: int, extra_args: list[str]) -> dict[str, str]:
     """One pytest pass over the chaos marker; returns {test_id: outcome}."""
@@ -99,6 +106,19 @@ def main() -> int:
             return 2
 
     baseline = runs[0]
+    missing = [
+        mod
+        for mod in REQUIRED_CHAOS_MODULES
+        if not any(mod in tid for tid in baseline)
+    ]
+    if missing:
+        print(
+            f"[chaos-check] FAIL: no chaos tests collected from: {', '.join(missing)}"
+            " (marker or module renamed? the gate must cover these suites)",
+            file=sys.stderr,
+        )
+        return 1
+
     drift = False
     for i, run in enumerate(runs[1:], start=2):
         all_ids = sorted(set(baseline) | set(run))
