@@ -1,3 +1,4 @@
 from .checkpoint import load_serving_params  # noqa: F401
+from .dispatch import DecodeDispatcher, resolve_dispatch_depth  # noqa: F401
 from .engine import InferenceEngine, Request  # noqa: F401
 from .speculative import SpecStats, generate_speculative  # noqa: F401

@@ -1,0 +1,344 @@
+"""Overlapped decode dispatch: device-resident carry + in-flight window.
+
+The serial serving loop was a strict host<->device ping-pong: build seven
+per-slot arrays with ``jnp.asarray`` (seven small H2D transfers), dispatch
+one decode chunk, block on ``jax.device_get`` for its tokens, then do all
+host work (emit, EOS, stop sequences, admission bookkeeping) while the
+device sits idle. Under JAX async dispatch none of that serialization is
+necessary — a jitted call returns futures immediately, and the ONLY true
+sync point is token readback. This module restructures the loop around
+that fact:
+
+- **Device-resident carry** (``self.carry``): the per-slot decode inputs
+  (``tokens``, ``positions``, ``temps``, ``top_ks``, ``top_ps``, block
+  ``tables``) live on device permanently and are *donated through* every
+  decode chunk, which returns them advanced (the scan already computed
+  next-token and next-position — the serial loop threw that away and
+  re-uploaded host copies). Host-side slot changes (admission, prefill
+  completion, preemption, spec-round commits, table growth) set per-slot
+  dirty flags; the next dispatch folds every dirty row into ONE jitted
+  masked merge (``_apply_carry_update``: two bool masks + a packed int
+  matrix + a packed float matrix + the table matrix) instead of seven
+  fresh uploads per iteration. Rows that are not dirty are
+  device-authoritative and never clobbered by stale host state.
+
+- **Dispatch-ahead window** (``self.window``, depth ``dispatch_depth``):
+  because the carry chains device-side, chunk N+1 can be dispatched
+  immediately after chunk N without reading chunk N's tokens. Token
+  readback moves to a FIFO of in-flight entries drained with non-blocking
+  ``jax.Array.is_ready()`` checks; the host only blocks when the window
+  is full (and then on the *oldest* entry, which the device finished or
+  is about to finish while the newest computes). Emit/EOS handling for
+  chunk N thus overlaps chunk N+1's decode. Depth 1 reproduces the
+  serial loop exactly — it is the escape hatch
+  (``DEVSPACE_ENGINE_OVERLAP=off``) and the reference the equivalence
+  suite compares against.
+
+- **Overshoot and zombies**: the engine already truncates host-side
+  (a slot that hits EOS or max_new mid-chunk discards the chunk tail),
+  so dispatch-ahead only widens the same speculation. A slot that
+  *finishes* while later chunks still reference it becomes a zombie:
+  its blocks stay allocated (``pending_free``) and the slot is not
+  re-admitted until every in-flight chunk referencing it has been
+  drained — the in-flight writes land in the slot's own blocks (or the
+  scratch block once a later dispatch parks it), never in a peer's.
+
+- **Failure ladder**: a decode failure surfaces at readback (async
+  dispatch defers device errors). ``abandon()`` drops the whole window
+  — every in-flight chunk's requests are failed by the caller
+  (``_fail_outstanding`` calls it first), refs/pending-free are cleared,
+  and the carry is rebuilt from scratch (it was donated into the failed
+  computation) — before the engine rebuilds the pool. Nothing is ever
+  read from a poisoned future.
+
+Per-slot stream equivalence (why depth does not change outputs): the
+decode kernel advances a slot's PRNG key and samples once per scan step
+*in which the slot is active*, attention reads only the slot's own
+blocks, and the slot's carry row chains device-side from its prefill
+seed. A slot's n-th emitted token is therefore a function of (prompt,
+seed, resume fold-in, n) only — independent of chunk sizes, co-resident
+membership, and window depth. The pinned suite
+(tests/test_engine_dispatch.py) asserts byte-identical streams between
+depth 1 and depth 2 across randomized admit/EOS/preemption traces.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover — import cycle guard, types only
+    from .engine import InferenceEngine
+
+
+def _toks_ready(toks) -> bool:
+    """Non-blocking readiness probe. ``jax.Array.is_ready()`` where
+    available; otherwise report NOT ready — the conservative direction:
+    an opportunistic drain is skipped, and correctness never depended on
+    it (the window-full blocking drain is what bounds the queue)."""
+    probe = getattr(toks, "is_ready", None)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:  # noqa: BLE001 — poisoned future: force the
+        return True  # blocking path so the error surfaces in drain
+
+
+class _InFlight:
+    """One dispatched-but-unread decode chunk."""
+
+    __slots__ = ("toks", "slots", "gens", "k_steps")
+
+    def __init__(self, toks, slots: list[int], gens: list[int], k_steps: int):
+        self.toks = toks  # [k_steps, B] device future
+        self.slots = slots  # participating slot indices
+        self.gens = gens  # slot.gen at dispatch (re-admission guard)
+        self.k_steps = k_steps
+
+
+class DecodeDispatcher:
+    """Owns the in-flight decode window and the device-resident carry for
+    one :class:`~devspace_tpu.inference.engine.InferenceEngine`.
+
+    The engine's scheduler thread is the only caller — nothing here is
+    locked. The dispatcher mutates engine state exactly where the serial
+    loop did (``pool``/``_keys`` reassignment on dispatch, ``_emit`` and
+    block freeing on drain); the engine keeps scheduling policy
+    (admission, preemption ladder, spec interleaving, chunk sizing)."""
+
+    def __init__(self, engine: "InferenceEngine", depth: int):
+        if not 1 <= int(depth) <= 8:
+            raise ValueError(f"dispatch_depth must be in 1..8, got {depth}")
+        self.engine = engine
+        self.depth = int(depth)
+        B = engine.max_slots
+        self.window: deque[_InFlight] = deque()
+        # per-slot count of in-flight chunks / in-flight decode steps
+        self.refs = [0] * B
+        self.inflight_steps = [0] * B
+        # slots that finished while still referenced by in-flight chunks:
+        # their blocks are freed when the last reference drains (the
+        # chunk's readback proves its pool writes completed)
+        self.pending_free: set[int] = set()
+        # host->device carry dirty flags; start all-dirty so the first
+        # dispatch uploads every participant's row
+        self._state_dirty = [True] * B
+        self._table_dirty = [True] * B
+        self.carry = self._fresh_carry()
+        # overlap counters (surfaced via engine.stats())
+        self.dispatches = 0
+        self.carry_updates = 0
+        self.occupancy_sum = 0  # window depth summed at each dispatch
+        self.readback_wait_s = 0.0  # host time blocked in device_get
+        self.loop_busy_s = 0.0  # scheduler-iteration time (engine adds)
+
+    # -- carry -------------------------------------------------------------
+    def _fresh_carry(self) -> dict:
+        B, mb = self.engine.max_slots, self.engine.max_blocks
+        return {
+            "tokens": jnp.zeros((B,), jnp.int32),
+            "positions": jnp.zeros((B,), jnp.int32),
+            "temps": jnp.zeros((B,), jnp.float32),
+            "top_ks": jnp.zeros((B,), jnp.int32),
+            "top_ps": jnp.ones((B,), jnp.float32),
+            "tables": jnp.zeros((B, mb), jnp.int32),
+        }
+
+    def invalidate_state(self, i: int) -> None:
+        """Host is now authoritative for slot i's token/position/sampling
+        row (admission, prefill completion, spec commit); the next
+        dispatch that includes i re-uploads it."""
+        self._state_dirty[i] = True
+
+    def invalidate_table(self, i: int) -> None:
+        """Slot i's block table changed (_alloc/_free_slot_blocks)."""
+        self._table_dirty[i] = True
+
+    def _sync_carry(self, plain: list[int]) -> None:
+        """Fold every dirty participating row into the device carry with
+        ONE jitted masked merge — the packed update that replaces the
+        serial loop's seven per-iteration ``jnp.asarray`` uploads."""
+        eng = self.engine
+        B = eng.max_slots
+        upd = [
+            i for i in plain if self._state_dirty[i] or self._table_dirty[i]
+        ]
+        if not upd:
+            return
+        state_mask = np.zeros((B,), bool)
+        table_mask = np.zeros((B,), bool)
+        ints = np.zeros((B, 3), np.int32)
+        floats = np.zeros((B, 2), np.float32)
+        for i in upd:
+            s = eng.slots[i]
+            if self._state_dirty[i]:
+                state_mask[i] = True
+                ints[i] = (s.last_token, s.length - 1, s.req.top_k)
+                floats[i] = (s.req.temperature, s.req.top_p)
+                self._state_dirty[i] = False
+            if self._table_dirty[i]:
+                table_mask[i] = True
+                self._table_dirty[i] = False
+        self.carry = eng._carry_update_jit(
+            self.carry,
+            jnp.asarray(state_mask),
+            jnp.asarray(table_mask),
+            jnp.asarray(ints),
+            jnp.asarray(floats),
+            jnp.asarray(eng._tables),
+        )
+        self.carry_updates += 1
+
+    # -- window ------------------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return len(self.window)
+
+    @property
+    def full(self) -> bool:
+        return len(self.window) >= self.depth
+
+    def slot_busy(self, i: int) -> bool:
+        """True while in-flight chunks still reference slot i — a
+        finished (zombie) slot must not be re-admitted until they drain,
+        because their writes target its still-allocated blocks."""
+        return self.refs[i] > 0
+
+    def dispatch(self, plain: list[int], k_steps: int, filters_on: bool) -> None:
+        """Send one decode chunk for ``plain`` (async: returns as soon as
+        the futures exist) and append it to the in-flight window."""
+        eng = self.engine
+        self._sync_carry(plain)
+        active = np.zeros((eng.max_slots,), bool)
+        for i in plain:
+            active[i] = True
+        eng.pool, self.carry, eng._keys, toks = eng._decode_chunk[
+            (k_steps, filters_on)
+        ](
+            eng.params,
+            eng.pool,
+            self.carry,
+            eng._keys,
+            jnp.asarray(active),
+            eng._eos_ids,
+            eng._min_until,
+            eng._logit_bias,
+        )
+        self.window.append(
+            _InFlight(
+                toks, list(plain), [eng.slots[i].gen for i in plain], k_steps
+            )
+        )
+        for i in plain:
+            self.refs[i] += 1
+            self.inflight_steps[i] += k_steps
+        self.dispatches += 1
+        self.occupancy_sum += len(self.window)
+
+    def drain(self, block: bool = False) -> int:
+        """Retire in-flight chunks in dispatch order. ``block=True``
+        forces readback of the oldest entry (the window-full / idle
+        path); after it, and always when ``block=False``, only entries
+        whose tokens are already host-visible are consumed — the
+        non-blocking readiness check that lets emit work overlap the
+        newest chunk's decode. Returns the number of entries drained."""
+        drained = 0
+        while self.window:
+            if not block and not _toks_ready(self.window[0].toks):
+                break
+            self._consume_oldest()
+            drained += 1
+            block = False
+        return drained
+
+    def drain_all(self) -> None:
+        """Blocking drain of the whole window — required before any
+        operation that assumes settled slot state: the preemption
+        ladder, a speculative round (it rewrites slot K/V and commits
+        host-side), and engine shutdown."""
+        while self.window:
+            self._consume_oldest()
+
+    def _consume_oldest(self) -> None:
+        entry = self.window.popleft()
+        t0 = time.monotonic()
+        try:
+            toks = np.asarray(jax.device_get(entry.toks))
+        finally:
+            self.readback_wait_s += time.monotonic() - t0
+        eng = self.engine
+        for n, i in enumerate(entry.slots):
+            self.refs[i] -= 1
+            self.inflight_steps[i] -= entry.k_steps
+            slot = eng.slots[i]
+            if slot.req is not None and slot.gen == entry.gens[n]:
+                for j in range(entry.k_steps):
+                    if slot.req is None:
+                        break  # finished mid-chunk; rest is speculative
+                    eng._emit(i, int(toks[j, i]))
+            if self.refs[i] == 0 and i in self.pending_free:
+                self.pending_free.discard(i)
+                eng._free_slot_blocks(i)
+
+    def abandon(self) -> None:
+        """Drop the whole in-flight window without reading it — the
+        failure path (``_fail_outstanding`` calls this before failing
+        slot-resident requests and rebuilding the pool). Every future in
+        the window may be poisoned, and the carry was donated into the
+        failed chain, so both are discarded; zombie blocks are released
+        host-side (the allocator is about to be reset or reused)."""
+        self.window.clear()
+        B = self.engine.max_slots
+        self.refs = [0] * B
+        self.inflight_steps = [0] * B
+        for i in sorted(self.pending_free):
+            self.engine._free_slot_blocks(i)
+        self.pending_free.clear()
+        self._state_dirty = [True] * B
+        self._table_dirty = [True] * B
+        self.carry = self._fresh_carry()
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        occ = (
+            round(self.occupancy_sum / self.dispatches, 3)
+            if self.dispatches
+            else 0.0
+        )
+        return {
+            "dispatch_depth": self.depth,
+            "dispatch_depth_occupancy": occ,
+            "decode_dispatches": self.dispatches,
+            "readback_wait_s": round(self.readback_wait_s, 4),
+            "host_sched_s": round(
+                max(0.0, self.loop_busy_s - self.readback_wait_s), 4
+            ),
+            "carry_updates": self.carry_updates,
+        }
+
+
+def resolve_dispatch_depth(dispatch_depth: Optional[int]) -> int:
+    """Window depth resolution: explicit constructor arg wins, then the
+    ``DEVSPACE_ENGINE_OVERLAP`` env knob (``off``/``0``/``serial`` -> the
+    serial depth-1 loop; an integer -> that depth), default 2 — overlap
+    is ON by default, depth 2 being the sweet spot (one chunk computing
+    while one drains; deeper windows only add speculative overshoot)."""
+    import os
+
+    if dispatch_depth is not None:
+        return int(dispatch_depth)
+    env = os.environ.get("DEVSPACE_ENGINE_OVERLAP", "").strip().lower()
+    if env in ("off", "0", "serial", "false", "no"):
+        return 1
+    if env in ("", "on", "true", "yes", "1", "default"):
+        return 2
+    try:
+        return max(1, int(env))
+    except ValueError:
+        return 2

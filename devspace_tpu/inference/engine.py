@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer as tfm
+from .dispatch import DecodeDispatcher, resolve_dispatch_depth
 from .prefix_cache import RadixPrefixCache
 
 
@@ -106,6 +107,16 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None
+    # wakes stream() consumers on every emitted token and on completion
+    # (event-driven delivery — no busy-poll); notified by the engine via
+    # _notify(), always AFTER the state change it announces
+    _cond: threading.Condition = field(
+        default_factory=threading.Condition, repr=False
+    )
+
+    def _notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
 
     def result(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -122,37 +133,47 @@ class Request:
         bursts of up to chunk_max). Raises like ``result`` on error, and
         TimeoutError when no NEW token arrives within ``timeout`` (the
         deadline resets on progress — a long healthy generation never
-        times out)."""
+        times out).
+
+        Delivery is event-driven: the engine notifies a per-request
+        Condition on every emit and at completion, so a waiting consumer
+        wakes immediately instead of busy-polling. ``poll`` is retained
+        for backward compatibility and ignored."""
+        del poll
         sent = 0
-        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            n = len(self.tokens)
-            if n > sent and timeout is not None:
-                deadline = time.monotonic() + timeout
+            with self._cond:
+                # every notify follows a token append or completion, so a
+                # full ``timeout`` with no wakeup means no progress
+                while len(self.tokens) <= sent and not self.done.is_set():
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError("generation stalled")
+                n = len(self.tokens)
+                finished = self.done.is_set()
             while sent < n:
                 yield self.tokens[sent]
                 sent += 1
-            if self.done.is_set():
+            if finished:
                 if self.error:
                     raise RuntimeError(self.error)
-                for tok in self.tokens[sent:]:
-                    yield tok
-                return
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("generation stalled")
-            self.done.wait(poll)
+                if sent >= len(self.tokens):
+                    return
 
 
 class _Slot:
     __slots__ = (
         "req", "length", "remaining", "last_token",
         "ready", "prefill_pos", "prompt", "admitted_at", "draft_ready",
+        "gen",
     )
 
     def __init__(self):
         self.req: Optional[Request] = None
         self.ready = False
         self.draft_ready = False
+        # admission generation: in-flight chunks record it at dispatch so
+        # a drained chunk can never emit into a slot's NEXT occupant
+        self.gen = 0
 
 
 class InferenceEngine:
@@ -188,6 +209,7 @@ class InferenceEngine:
         kv_dtype: Optional[str] = None,
         prefix_cache: bool = True,
         prewarm: bool = False,
+        dispatch_depth: Optional[int] = None,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
@@ -246,7 +268,15 @@ class InferenceEngine:
         in private blocks past the matched prefix.
 
         ``prewarm=True`` compiles every reachable program in ``start()``
-        before the scheduler thread runs (see :meth:`prewarm`)."""
+        before the scheduler thread runs (see :meth:`prewarm`).
+
+        ``dispatch_depth`` sizes the overlapped serving loop's in-flight
+        decode window (inference/dispatch.py): depth 2 (the default)
+        dispatches chunk N+1 before reading chunk N's tokens, so host
+        scheduling/emit work overlaps device compute; depth 1 is the
+        serial reference loop (escape hatch:
+        ``DEVSPACE_ENGINE_OVERLAP=off``). Token streams are identical at
+        every depth (pinned by tests/test_engine_dispatch.py)."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -446,21 +476,27 @@ class InferenceEngine:
         def decode_chunk(
             params,
             pool,
-            tables,
-            tokens,
-            positions,
-            temps,
-            top_ks,
-            top_ps,
+            carry,
             keys,
+            active,
             eos_ids,
             min_until,
             logit_bias,
             n_steps,
             use_filters,
         ):
-            def step(carry, _):
-                pool, tok, pos, keys = carry
+            # the device-resident carry (inference/dispatch.py) holds the
+            # per-slot decode inputs; inactive rows (parked, mid-prefill,
+            # or zombie slots whose old chunks are still in flight) get an
+            # all-zeros table row so their garbage writes land in the
+            # scratch block — the same convention _decode_tables used
+            tables = jnp.where(active[:, None], carry["tables"], 0)
+            temps = carry["temps"]
+            top_ks = carry["top_ks"]
+            top_ps = carry["top_ps"]
+
+            def step(c, _):
+                pool, tok, pos, keys = c
                 logits, pool = tfm.decode_tokens_paged(
                     params, pool, tables, tok, pos, cfg, tp=self._tp
                 )
@@ -476,7 +512,13 @@ class InferenceEngine:
                 )
                 logits = jnp.where(suppress, -jnp.inf, logits)
                 split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                keys, subs = split[:, 0], split[:, 1]
+                subs = split[:, 1]
+                # a slot's key advances once per step IT decodes, never
+                # during peers' chunks — its sampled stream is then a
+                # function of (seed, own step count) only, independent of
+                # co-resident membership and dispatch-window depth (the
+                # equivalence the overlapped loop is pinned to)
+                keys = jnp.where(active[:, None], split[:, 0], keys)
                 if use_filters:
                     tok = jax.vmap(sample_logits)(
                         subs, logits, temps, top_ks, top_ps
@@ -497,22 +539,58 @@ class InferenceEngine:
                 pos = jnp.minimum(pos + 1, self.max_len - 1)
                 return (pool, tok, pos, keys), tok
 
-            (pool, _, _, keys), toks = jax.lax.scan(
-                step, (pool, tokens, positions, keys), None, length=n_steps
+            (pool, tok, pos, keys), toks = jax.lax.scan(
+                step,
+                (pool, carry["tokens"], carry["positions"], keys),
+                None,
+                length=n_steps,
             )
-            return pool, keys, toks  # toks [n_steps, B]
+            # the advanced token/position rows chain into the next chunk
+            # device-side — dispatch-ahead never reads them back
+            carry = dict(carry, tokens=tok, positions=pos)
+            return pool, carry, keys, toks  # toks [n_steps, B]
 
-        # one compile per (chunk size, filters on/off) — both static
+        # one compile per (chunk size, filters on/off) — both static;
+        # pool AND carry are donated: the carry threads dispatch-to-
+        # dispatch exactly like the pool does
         from functools import partial as _partial
 
         self._decode_chunk = {
             (k, filt): jax.jit(
                 _partial(decode_chunk, n_steps=k, use_filters=filt),
-                donate_argnums=1,
+                donate_argnums=(1, 2),
             )
             for k in self._chunk_sizes()
             for filt in (False, True)
         }
+
+        def apply_carry_update(carry, state_mask, table_mask, ints, floats, tables):
+            # ONE packed host->device refresh for every dirty slot row
+            # (ints [B,3] = token, position, top_k; floats [B,2] = temp,
+            # top_p): masked merge so device-authoritative rows — whose
+            # tokens/positions self-advanced inside decode chunks — are
+            # never clobbered by stale host copies. Two masks because
+            # table growth must not touch a live slot's token/position.
+            sm = state_mask
+            return {
+                "tokens": jnp.where(sm, ints[:, 0], carry["tokens"]),
+                "positions": jnp.where(sm, ints[:, 1], carry["positions"]),
+                "top_ks": jnp.where(sm, ints[:, 2], carry["top_ks"]),
+                "temps": jnp.where(sm, floats[:, 0], carry["temps"]),
+                "top_ps": jnp.where(sm, floats[:, 1], carry["top_ps"]),
+                "tables": jnp.where(
+                    table_mask[:, None], tables, carry["tables"]
+                ),
+            }
+
+        self._carry_update_jit = jax.jit(apply_carry_update, donate_argnums=0)
+        # overlapped serving loop state (created LAST: the dispatcher's
+        # carry shapes come from the allocator/config fields above)
+        self._dispatcher = DecodeDispatcher(
+            self, resolve_dispatch_depth(dispatch_depth)
+        )
+        self.dispatch_depth = self._dispatcher.depth
+        self._prefill_cursor = -1  # rotating prefill pick (see _loop)
 
         # chunked prefill: jit's shape-keyed cache compiles once per chunk
         # bucket (power-of-two final chunks + the full prefill_chunk)
@@ -793,18 +871,19 @@ class InferenceEngine:
                 jnp.asarray(0, jnp.int32),
             )
             timings[f"prefill_{c}"] = round(time.monotonic() - t0, 3)
+        d = self._dispatcher
+        all_parked = jnp.zeros((B,), bool)
         for (k, filt), fn in self._decode_chunk.items():
             t0 = time.monotonic()
-            self.pool, self._keys, _ = fn(
+            # the dispatcher's device carry is donated through, exactly
+            # like serving dispatches; all-parked means zero tables, so
+            # writes land in scratch block 0
+            self.pool, d.carry, self._keys, _ = fn(
                 self.params,
                 self.pool,
-                zero_tables,
-                zb,
-                zb,
-                jnp.zeros((B,), jnp.float32),
-                zb,
-                jnp.ones((B,), jnp.float32),
+                d.carry,
                 self._keys,
+                all_parked,
                 self._eos_ids,
                 self._min_until,
                 self._logit_bias,
@@ -812,6 +891,16 @@ class InferenceEngine:
             timings[f"decode_{k}{'_filters' if filt else ''}"] = round(
                 time.monotonic() - t0, 3
             )
+        t0 = time.monotonic()
+        d.carry = self._carry_update_jit(
+            d.carry,
+            all_parked,
+            all_parked,
+            jnp.zeros((B, 3), jnp.int32),
+            jnp.zeros((B, 2), jnp.float32),
+            zero_tables,
+        )
+        timings["carry_update"] = round(time.monotonic() - t0, 3)
         if self.draft_params is not None:
             # _draft_prefill buckets: powers of two, clamped at max_len
             # (itself a bucket when not a power of two)
@@ -883,6 +972,11 @@ class InferenceEngine:
             )
             if self.spec_proposed
             else 0.0,
+            # overlapped-loop observability (inference/dispatch.py):
+            # window occupancy at dispatch, host time blocked on token
+            # readback vs. host time spent scheduling, and how many
+            # packed carry refreshes the slot churn actually cost
+            **self._dispatcher.stats(),
         }
 
     def stop(self) -> None:
@@ -931,6 +1025,8 @@ class InferenceEngine:
             self._block_refs[blk] = 1
             self._tables[slot_idx, self._nalloc[slot_idx]] = blk
             self._nalloc[slot_idx] += 1
+        if need:
+            self._dispatcher.invalidate_table(slot_idx)
         return True
 
     def _free_slot_blocks(self, slot_idx: int) -> None:
@@ -947,6 +1043,7 @@ class InferenceEngine:
                 self._free_blocks.append(b)
         self._tables[slot_idx, :] = 0
         self._nalloc[slot_idx] = 0
+        self._dispatcher.invalidate_table(slot_idx)
 
     def _match_prefix(self, prompt: list) -> list:
         """Longest run of already-cached full prompt blocks, capped so at
@@ -1001,11 +1098,25 @@ class InferenceEngine:
         return jnp.asarray(t)
 
     # -- scheduler ---------------------------------------------------------
+    @staticmethod
+    def _finish(req: Request) -> None:
+        """Terminal wakeup: set done, then wake stream() waiters. done
+        FIRST so a woken consumer observes the finished state."""
+        req.done.set()
+        req._notify()
+
     def _fail_outstanding(self, reason: str, drain_queue: bool = True) -> None:
         """Fail slot-resident requests (their K/V lives in the pool).
         ``drain_queue=False`` spares queued requests that were never
         admitted — after a cache loss they have no state to lose and a
-        rebuilt pool can still serve them; only stop() drains the queue."""
+        rebuilt pool can still serve them; only stop() drains the queue.
+
+        The in-flight dispatch window is abandoned FIRST: its futures may
+        be poisoned (async dispatch surfaces device errors at readback)
+        and its chunks' requests are exactly the slot-resident ones
+        failed below — nothing may read from or emit out of it after
+        this point."""
+        self._dispatcher.abandon()
         for i, slot in enumerate(self.slots):
             req = slot.req  # snapshot: a live scheduler may race us when
             if req is None:  # stop()'s join timed out on a wedged dispatch
@@ -1017,13 +1128,13 @@ class InferenceEngine:
                 continue  # completed concurrently — don't double-count
             req.error = reason
             self.requests_failed += 1
-            req.done.set()  # done LAST (see _emit)
+            self._finish(req)  # done LAST (see _emit)
         if not drain_queue:
             return
         for req in self._resume:
             req.error = reason
             self.requests_failed += 1
-            req.done.set()  # done LAST (see _emit)
+            self._finish(req)  # done LAST (see _emit)
         self._resume.clear()
         while True:
             try:
@@ -1032,7 +1143,7 @@ class InferenceEngine:
                 break
             req.error = reason
             self.requests_failed += 1
-            req.done.set()  # done LAST (see _emit)
+            self._finish(req)  # done LAST (see _emit)
 
     def _recover_pool_if_lost(self) -> None:
         """After a failed prefill/decode dispatch: the pool may have been
@@ -1060,6 +1171,11 @@ class InferenceEngine:
         self._nalloc = [0] * self.max_slots
         self._prefix_cache.reset()
         self._block_refs.clear()
+        # the keys array is an OUTPUT of the failed decode chain under
+        # async dispatch — a poisoned future that would re-raise on the
+        # next dispatch. Rebuild it; live slots were failed with the pool
+        # and re-admissions reseed their rows at prefill completion.
+        self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
 
     def _bucket(self, n: int) -> int:
         b = 1
@@ -1128,6 +1244,7 @@ class InferenceEngine:
         assert ok, "availability was checked above"
         self.prefix_hit_blocks += len(matched)
         slot = self.slots[slot_idx]
+        slot.gen += 1  # new occupant: stale in-flight chunks must not emit
         slot.req = req
         slot.prompt = prompt
         # skip straight past the cached prefix: its K/V is already in
@@ -1251,6 +1368,9 @@ class InferenceEngine:
                 self._draft_prefill(slot_idx)
             slot.ready = True
             self._emit(slot_idx, int(first))
+            # host is authoritative for this slot's carry row until its
+            # first decode dispatch re-uploads it
+            self._dispatcher.invalidate_state(slot_idx)
 
     def _draft_prefill(self, slot_idx: int) -> None:
         """Seed the slot's dense draft-cache row in ONE bucketed forward
@@ -1325,6 +1445,7 @@ class InferenceEngine:
         slot = self.slots[slot_idx]
         req = slot.req
         req.tokens.append(token)
+        req._notify()  # wake stream() consumers (event-driven delivery)
         self.tokens_generated += 1
         slot.last_token = token
         slot.length += 1
@@ -1358,11 +1479,21 @@ class InferenceEngine:
         if finish:
             slot.req = None
             slot.ready = False
-            self._free_slot_blocks(slot_idx)
+            self._retire_slot(slot_idx)
             self.requests_completed += 1
             # done LAST: result()/stats() callers wake on it and must see
             # the counters and the freed blocks already settled
-            req.done.set()
+            self._finish(req)
+
+    def _retire_slot(self, slot_idx: int) -> None:
+        """Release a finished slot's blocks — immediately when no decode
+        chunk references it, otherwise deferred until the last in-flight
+        chunk drains (the chunk's overshoot writes target these blocks;
+        the slot stays un-admittable meanwhile — see slot_busy)."""
+        if self._dispatcher.slot_busy(slot_idx):
+            self._dispatcher.pending_free.add(slot_idx)
+        else:
+            self._free_slot_blocks(slot_idx)
 
     def _next_pending(self) -> Optional[Request]:
         if self._resume:
@@ -1372,33 +1503,96 @@ class InferenceEngine:
         except queue.Empty:
             return None
 
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            # admit as many pending requests as there are free slots
-            # (admission only reserves blocks — prefill is incremental)
-            for i, slot in enumerate(self.slots):
-                if slot.req is not None:
-                    continue
-                req = self._next_pending()
-                if req is None:
+    def _admit_pending(self) -> None:
+        """Admit as many pending requests as there are free slots
+        (admission only reserves blocks — prefill is incremental). A slot
+        still referenced by in-flight decode chunks (a zombie: finished,
+        but its blocks receive overshoot writes until the window drains)
+        is skipped until the dispatcher releases it."""
+        for i, slot in enumerate(self.slots):
+            if slot.req is not None or self._dispatcher.slot_busy(i):
+                continue
+            req = self._next_pending()
+            if req is None:
+                break
+            try:
+                if not self._admit(i, req):
+                    # pool full — keep it queued at the front
+                    self._resume.insert(0, req)
                     break
-                try:
-                    if not self._admit(i, req):
-                        # pool full — keep it queued at the front
-                        self._resume.insert(0, req)
-                        break
-                except Exception as e:  # noqa: BLE001 — surface per-request
-                    req.error = str(e)
-                    # _admit may have reserved blocks (and prefix-cache
-                    # refs) before raising — e.g. in the device work of
-                    # _sync_sampling_extras. Release them or the pool
-                    # shrinks permanently; idempotent when nothing was
-                    # reserved (_nalloc is 0).
-                    self._free_slot_blocks(i)
-                    self.slots[i].req = None
-                    self.requests_failed += 1
-                    self._recover_pool_if_lost()
-                    req.done.set()  # done LAST (see _emit)
+            except Exception as e:  # noqa: BLE001 — surface per-request
+                req.error = str(e)
+                # _admit may have reserved blocks (and prefix-cache
+                # refs) before raising — e.g. in the device work of
+                # _sync_sampling_extras. Release them or the pool
+                # shrinks permanently; idempotent when nothing was
+                # reserved (_nalloc is 0).
+                self._free_slot_blocks(i)
+                self.slots[i].req = None
+                self.requests_failed += 1
+                self._recover_pool_if_lost()
+                self._finish(req)  # done LAST (see _emit)
+
+    def _next_prefill_slot(self, prefilling: list[int]) -> int:
+        """Rotating pick over prefilling slots: lowest index strictly
+        above the previous pick, wrapping to the lowest — so high-index
+        admissions make prefill progress under load instead of starving
+        behind slot 0 (the old loop always took ``prefilling[0]``).
+        Pinned by tests/test_engine_dispatch.py."""
+        after = [i for i in prefilling if i > self._prefill_cursor]
+        i = after[0] if after else prefilling[0]
+        self._prefill_cursor = i
+        return i
+
+    def _spec_eligible(self, ready: list[int]) -> list[int]:
+        """Slots riding this iteration's speculative round: draft cache
+        seeded, far enough from max_len that a depth-R verification
+        window fits, and using no per-slot sampling extras (the spec
+        round samples without them — biased slots would commit unbiased
+        tokens, and min-length slots could commit suppressed EOS; both
+        take the plain path, which applies them). Truthiness: an empty
+        logit_bias dict is a no-op and must not disqualify the slot."""
+        if self.draft_params is None:
+            return []
+        # a depth-R dispatch can advance R*(k+1) tokens; its last verify
+        # write lands at length-2 + R*(k+1), which must stay inside
+        # max_len (R=1 reduces to length+k <= max_len)
+        spec_span = self.spec_depth * (self.spec_k + 1)
+        return [
+            i
+            for i in ready
+            # greedy AND sampling (incl. top-k/top-p: the accept/resample
+            # rule runs against the FILTERED target distribution —
+            # lossless in distribution for any proposal distribution)
+            if self.slots[i].draft_ready
+            and self.slots[i].length + spec_span - 1 <= self.max_len
+            and not self.slots[i].req.logit_bias
+            and len(self.slots[i].req.tokens)
+            >= self.slots[i].req.min_new_tokens
+        ]
+
+    def _dispatch_failed(self, e: Exception) -> None:
+        """A decode dispatch or its readback died (async dispatch
+        surfaces device errors at readback time). The pool and the device
+        carry were donated into the failed chain and may be invalid:
+        fail the WHOLE in-flight window (every chunk chains off the
+        poisoned pool) rather than hang any caller, then rebuild a clean
+        pool and keep serving new requests."""
+        self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
+        self._reset_pool()  # donated buffer is gone
+        self._reset_draft_cache()
+
+    def _loop(self) -> None:
+        """Scheduler iterations: admission, ONE bounded prefill chunk,
+        spec-round interleaving, chunk sizing + block coverage (with the
+        preemption ladder), then an ASYNC decode dispatch. The
+        DecodeDispatcher (inference/dispatch.py) owns the in-flight
+        window and device-resident carry; emit/EOS handling happens when
+        entries drain — overlapping the newest chunk's device compute."""
+        d = self._dispatcher
+        while not self._stop.is_set():
+            t_iter = time.monotonic()
+            self._admit_pending()
             prefilling = [
                 i
                 for i, s in enumerate(self.slots)
@@ -1408,6 +1602,16 @@ class InferenceEngine:
                 i for i, s in enumerate(self.slots) if s.req is not None and s.ready
             ]
             if not prefilling and not ready:
+                if d.in_flight:
+                    # nothing schedulable, but chunks are in flight —
+                    # their readback is the only source of new work
+                    # (zombie slots free, completions emit)
+                    try:
+                        d.drain(block=True)
+                    except Exception as e:  # noqa: BLE001
+                        self._dispatch_failed(e)
+                    d.loop_busy_s += time.monotonic() - t_iter
+                    continue
                 # idle: wait for work
                 try:
                     req = self.pending.get(timeout=0.05)
@@ -1415,10 +1619,10 @@ class InferenceEngine:
                     continue
                 self._resume.insert(0, req)
                 continue
-            # ONE bounded prefill chunk per iteration (round-robin over
+            # ONE bounded prefill chunk per iteration (rotating over
             # prefilling slots), so admission never starves decode
             if prefilling:
-                i = prefilling[0]
+                i = self._next_prefill_slot(prefilling)
                 try:
                     self._prefill_one_chunk(i)
                 except Exception as e:  # noqa: BLE001
@@ -1433,59 +1637,77 @@ class InferenceEngine:
                     self._recover_pool_if_lost()
                     self._reset_draft_cache()  # draft prefill may have died
                     if req is not None:
-                        req.done.set()  # done LAST (see _emit)
+                        self._finish(req)  # done LAST (see _emit)
                 if not ready:
-                    continue  # nothing to decode yet — keep prefilling
+                    # nothing to decode yet — but finished in-flight
+                    # chunks can retire while the next prompt prefills
+                    try:
+                        d.drain(block=False)
+                    except Exception as e:  # noqa: BLE001
+                        self._dispatch_failed(e)
+                    d.loop_busy_s += time.monotonic() - t_iter
+                    continue
             if not ready:
                 continue
-            # split ready slots into the SPECULATIVE group (greedy, draft
-            # cache seeded, far enough from max_len that the k+1-token
-            # verification block fits) and the PLAIN decode group; both
-            # dispatch in the same iteration so neither starves — a slot
-            # that outgrows spec eligibility (near max_len, monotone)
-            # simply finishes on the plain path
-            spec_idx: list[int] = []
-            if self.draft_params is not None:
-                # a depth-R dispatch can advance R*(k+1) tokens; its last
-                # verify write lands at length-2 + R*(k+1), which must
-                # stay inside max_len (R=1 reduces to length+k <= max_len)
-                spec_span = self.spec_depth * (self.spec_k + 1)
-                spec_idx = [
+            # split ready slots into the SPECULATIVE group and the PLAIN
+            # decode group; both dispatch in the same iteration so
+            # neither starves — a slot that outgrows spec eligibility
+            # (near max_len, monotone) simply finishes on the plain path
+            spec_idx = self._spec_eligible(ready)
+            if spec_idx and d.in_flight:
+                # a spec round reads AND rewrites slot K/V and commits
+                # host-side — it needs settled state, so the window
+                # drains first; eligibility is then recomputed because
+                # the drain advanced lengths and may finish slots
+                try:
+                    d.drain_all()
+                except Exception as e:  # noqa: BLE001
+                    self._dispatch_failed(e)
+                    d.loop_busy_s += time.monotonic() - t_iter
+                    continue
+                ready = [
                     i
                     for i in ready
-                    # greedy AND sampling (incl. top-k/top-p: the
-                    # accept/resample rule runs against the FILTERED
-                    # target distribution — lossless in distribution
-                    # for any proposal distribution)
-                    if self.slots[i].draft_ready
-                    and self.slots[i].length + spec_span - 1 <= self.max_len
-                    # the spec round samples without the per-slot extras:
-                    # biased slots would commit unbiased tokens, and
-                    # min-length slots could commit suppressed EOS — both
-                    # take the plain path (which applies them) instead
-                    # (truthiness: an empty logit_bias dict is a no-op
-                    # and must not disqualify the slot)
-                    and not self.slots[i].req.logit_bias
-                    and len(self.slots[i].req.tokens)
-                    >= self.slots[i].req.min_new_tokens
+                    if self.slots[i].req is not None and self.slots[i].ready
                 ]
-            plain = [i for i in ready if i not in spec_idx]
-            # Plain chunk size: sized to the LONGEST remaining want
-            # (rounded down to a compiled power of two) — clamping to the
-            # shortest would put the whole batch back in the one-round-
-            # trip-per-token regime whenever any short request is
+                spec_idx = self._spec_eligible(ready)
+            # Plain group: every ready non-spec slot that still has
+            # tokens to produce BEYOND what in-flight chunks already
+            # cover (a slot whose whole remainder is in flight will
+            # finish when those chunks drain — dispatching for it would
+            # be pure overshoot)
+            plain = [
+                i
+                for i in ready
+                if i not in spec_idx
+                and self.slots[i].remaining - d.inflight_steps[i] >= 1
+            ]
+            # Plain chunk size: sized to the LONGEST effective remaining
+            # want (rounded down to a compiled power of two) — clamping
+            # to the shortest would put the whole batch back in the one-
+            # round-trip-per-token regime whenever any short request is
             # co-resident. Slots that finish mid-chunk (EOS or
             # remaining=0) truncate host-side; the overshoot compute is
-            # already paid by the static batch.
+            # already paid by the static batch. In-flight steps count as
+            # already-produced: the window must not inflate the want.
             if plain:
-                want = max(self.slots[i].remaining for i in plain)
-                room = min(self.max_len - self.slots[i].length for i in plain)
+                want = max(
+                    self.slots[i].remaining - d.inflight_steps[i]
+                    for i in plain
+                )
+                room = min(
+                    self.max_len
+                    - (self.slots[i].length + d.inflight_steps[i])
+                    for i in plain
+                )
                 k_steps = self._pick_chunk(max(1, min(want, room + 1)))
             else:
                 k_steps = 1
             # grow every participating slot's table to cover this
             # iteration's writes; preempt youngest-first when the pool
             # runs dry
+            plain_set = set(plain)
+            restart = False
             for i in list(ready):
                 s = self.slots[i]
                 if s.req is None or not s.ready:
@@ -1500,24 +1722,46 @@ class InferenceEngine:
                     need_upto = (
                         s.length - 1 + self.spec_depth * (self.spec_k + 1)
                     )
-                else:
+                elif i in plain_set:
                     # writes never pass max_len-1 (the decode scan clamps
                     # its positions), so coverage past max_len is never
-                    # needed — and would index past the table row
-                    need_upto = min(s.length + k_steps, self.max_len)
+                    # needed — and would index past the table row.
+                    # In-flight chunks write up to length+inflight first.
+                    need_upto = min(
+                        s.length + d.inflight_steps[i] + k_steps,
+                        self.max_len,
+                    )
+                else:
+                    continue  # remainder fully covered by the window
                 while not self._alloc(i, need_upto):
+                    if d.in_flight:
+                        # in-flight chunks pin their slots' blocks (and
+                        # may finish slots, freeing blocks): settle the
+                        # window before preempting anyone, then rebuild
+                        # the whole schedule from settled state
+                        try:
+                            d.drain_all()
+                        except Exception as e:  # noqa: BLE001
+                            self._dispatch_failed(e)
+                        restart = True
+                        break
                     if not self._preempt_youngest(keep=i):
                         # nothing else to evict: requeue this slot itself
                         # (a lone max_len resident always fits, so this
                         # only fires when prefilling peers hold the pool)
                         self._preempt(i)
                         break
+                if restart:
+                    break
                 if s.req is None:  # got preempted itself
                     ready.remove(i)
+            if restart:
+                d.loop_busy_s += time.monotonic() - t_iter
+                continue
             # liveness re-filter for BOTH groups: _preempt_youngest picks
             # by admitted_at, not index order, so a victim whose own
             # alloc turn already passed is still listed — the dispatch
-            # arrays below must never see a req=None slot as live
+            # must never see a req=None slot as live
             spec_idx = [
                 i
                 for i in spec_idx
@@ -1530,6 +1774,10 @@ class InferenceEngine:
             ]
             if spec_idx:
                 self._run_spec_round(spec_idx)
+                # the host committed tokens for these slots — it is
+                # authoritative for their carry rows again
+                for i in spec_idx:
+                    d.invalidate_state(i)
                 # spec commits may complete slots and free blocks; the
                 # plain dispatch below rebuilds its views from live state
                 plain = [
@@ -1537,80 +1785,25 @@ class InferenceEngine:
                     for i in plain
                     if self.slots[i].req is not None and self.slots[i].ready
                 ]
-            if not plain:
-                continue
-            plain_set = set(plain)
-            tokens = jnp.asarray(
-                [
-                    (s.last_token if i in plain_set else 0)
-                    for i, s in enumerate(self.slots)
-                ],
-                dtype=jnp.int32,
-            )
-            positions = jnp.asarray(
-                [
-                    (s.length - 1 if i in plain_set else 0)
-                    for i, s in enumerate(self.slots)
-                ],
-                dtype=jnp.int32,
-            )
-            temps = jnp.asarray(
-                [
-                    (s.req.temperature if i in plain_set else 0.0)
-                    for i, s in enumerate(self.slots)
-                ],
-                dtype=jnp.float32,
-            )
-            top_ks = jnp.asarray(
-                [
-                    (s.req.top_k if i in plain_set else 0)
-                    for i, s in enumerate(self.slots)
-                ],
-                dtype=jnp.int32,
-            )
-            top_ps = jnp.asarray(
-                [
-                    (s.req.top_p if i in plain_set else 1.0)
-                    for i, s in enumerate(self.slots)
-                ],
-                dtype=jnp.float32,
-            )
-            filters_on = any(
-                i in plain_set
-                and (s.req.top_k > 0 or s.req.top_p < 1.0)
-                for i, s in enumerate(self.slots)
-            )
             try:
-                self.pool, self._keys, toks = self._decode_chunk[
-                    (k_steps, filters_on)
-                ](
-                    self.params,
-                    self.pool,
-                    self._decode_tables(include=plain_set),
-                    tokens,
-                    positions,
-                    temps,
-                    top_ks,
-                    top_ps,
-                    self._keys,
-                    self._eos_ids,
-                    self._min_until,
-                    self._logit_bias,
-                )
-                toks = jax.device_get(toks)  # [k_steps, B] — one round-trip
-                for i in plain:
-                    for j in range(k_steps):
-                        if self.slots[i].req is None:
-                            break  # finished mid-chunk; rest is speculative
-                        self._emit(i, int(toks[j, i]))
+                if plain:
+                    plain_set = set(plain)
+                    filters_on = any(
+                        i in plain_set
+                        and (s.req.top_k > 0 or s.req.top_p < 1.0)
+                        for i, s in enumerate(self.slots)
+                    )
+                    # ASYNC: returns as soon as the futures exist — the
+                    # device computes while the drain below does emit/EOS
+                    # work for the previous chunk
+                    d.dispatch(plain, k_steps, filters_on)
+                # window full (or nothing new dispatched): block on the
+                # OLDEST entry — the device is computing the newest one
+                # meanwhile; otherwise consume only already-ready entries
+                d.drain(block=d.full or not plain)
             except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
-                # The pool was donated into the failed call and may be
-                # invalid; fail everything in flight rather than hang
-                # every caller, then rebuild a clean pool and keep
-                # serving new requests.
-                self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
-                self._reset_pool()  # donated buffer is gone
-                self._reset_draft_cache()
+                self._dispatch_failed(e)
+            d.loop_busy_s += time.monotonic() - t_iter
 
     def _run_spec_round(self, spec_idx: list[int]) -> None:
         """One speculative round for ``spec_idx`` slots (others parked):

@@ -119,6 +119,12 @@ class Server:
             # SPEC_DEPTH chains that many draft/verify rounds per
             # dispatch — the amortization lever for high-RTT links
             spec_depth=int(os.environ.get("SPEC_DEPTH", 1)),
+            # ENGINE_OVERLAP=off forces the serial decode loop (depth 1);
+            # default overlaps host scheduling with device compute via
+            # the depth-2 dispatch-ahead window (docs/inference.md)
+            dispatch_depth=(
+                1 if os.environ.get("ENGINE_OVERLAP") == "off" else None
+            ),
         )
         # PREWARM=1 compiles every prefill bucket / decode chunk / spec
         # program before the port opens — no mid-serving XLA compiles

@@ -54,6 +54,13 @@ PHASES = set(
         "BENCH_PHASES", "serial,engine,spec,admission,pressure"
     ).split(",")
 )
+# --engine-overlap off (or BENCH_OVERLAP=off) forces the serial loop
+# (dispatch_depth=1); default runs the overlapped loop at its default
+# depth. The ISSUE 5 escape hatch, also honored by DEVSPACE_ENGINE_OVERLAP.
+OVERLAP = os.environ.get("BENCH_OVERLAP", "on")
+if "--engine-overlap" in sys.argv:
+    OVERLAP = sys.argv[sys.argv.index("--engine-overlap") + 1]
+DISPATCH_DEPTH = 1 if OVERLAP == "off" else None
 
 # What the latency stats time (VERDICT r3 next #7): the engine's chunked
 # decode delivers up to chunk_max tokens per dispatch, so CLIENT-VISIBLE
@@ -187,28 +194,35 @@ def main():
     def timed_wave(engine):
         """Warmup/compile wave at FULL length (short warmups would leave
         the larger chunk kernels to compile inside the timed window),
-        then the timed wave. Returns (seconds, stats-delta dict)."""
+        then the timed wave. Returns (seconds, stats-delta dict, final
+        stats dict)."""
         try:
             for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
                 h.result(timeout=600)
+            # settle: the loop's final compile-wave iteration flushes its
+            # counters shortly after the last emit — don't let warmup
+            # compile time leak into the timed-wave deltas
+            time.sleep(0.5)
             before = engine.stats()
             t0 = time.time()
             for h in [engine.submit(p, NEW_TOKENS) for p in prompts]:
                 h.result(timeout=600)
             elapsed = time.time() - t0
-            delta = {
-                k: v - before[k]
-                for k, v in engine.stats().items()
-                if isinstance(v, int) and isinstance(before.get(k), int)
-            }
         finally:
-            engine.stop()
-        return elapsed, delta
+            engine.stop()  # joins the loop; counters are final after this
+        after = engine.stats()
+        delta = {
+            k: v - before[k]
+            for k, v in after.items()
+            if isinstance(v, int) and isinstance(before.get(k), int)
+        }
+        return elapsed, delta, after
 
     # engine: all 8 in flight
     engine_s = None
+    overlap_stats = None
     if "engine" in PHASES:
-        engine_s, _ = timed_wave(
+        engine_s, _, est = timed_wave(
             InferenceEngine(
                 params,
                 CFG,
@@ -216,12 +230,28 @@ def main():
                 max_len=256,
                 chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
                 kv_dtype=KV_DTYPE,
+                dispatch_depth=DISPATCH_DEPTH,
             ).start()
         )
         ratio = f" -> {serial_s / engine_s:.2f}x serial" if serial_s else ""
         print(
             f"[inf-bench] continuous batching: {total_new / engine_s:.1f} tok/s "
             f"({engine_s:.2f}s){ratio}",
+            file=sys.stderr,
+        )
+        overlap_stats = {
+            "mode": OVERLAP,
+            "dispatch_depth": est["dispatch_depth"],
+            "dispatch_depth_occupancy": est["dispatch_depth_occupancy"],
+            "readback_wait_s": est["readback_wait_s"],
+            "host_sched_s": est["host_sched_s"],
+            "carry_updates": est["carry_updates"],
+        }
+        print(
+            f"[inf-bench] overlap: depth {est['dispatch_depth']} "
+            f"occupancy {est['dispatch_depth_occupancy']}, readback_wait "
+            f"{est['readback_wait_s']}s, host_sched {est['host_sched_s']}s, "
+            f"carry_updates {est['carry_updates']}",
             file=sys.stderr,
         )
 
@@ -236,7 +266,7 @@ def main():
     spec = None
     if "spec" in PHASES:
         trained = draft_params is not None
-        spec_s, st = timed_wave(
+        spec_s, st, _ = timed_wave(
             InferenceEngine(
                 params,
                 CFG,
@@ -248,6 +278,7 @@ def main():
                 spec_k=int(os.environ.get("BENCH_SPEC_K", 4)),
                 spec_depth=int(os.environ.get("BENCH_SPEC_DEPTH", 1)),
                 kv_dtype=KV_DTYPE,
+                dispatch_depth=DISPATCH_DEPTH,
             ).start()
         )
         # st holds TIMED-WAVE deltas (the compile wave runs the same
@@ -309,6 +340,7 @@ def main():
             chunk_max=4,
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", 64)),
             kv_dtype=KV_DTYPE,
+            dispatch_depth=DISPATCH_DEPTH,
             # this phase measures LONG-PROMPT admission contention; the
             # warmup shares the long prompt's prefix, so default-on
             # prefix caching would quietly skip ~2/3 of the measured
@@ -375,6 +407,7 @@ def main():
         if serial_s
         else None,
         "interarrival_during_admission_ms": admission_stats,
+        "engine_overlap": overlap_stats,
         "speculative": spec,
         "pressure": pressure,
         "config": {
@@ -461,6 +494,7 @@ def _pressure_phase(params, rng) -> dict:
         block_size=p_block,
         n_blocks=p_blocks,
         kv_dtype=KV_DTYPE,
+        dispatch_depth=DISPATCH_DEPTH,
     ).start()
     try:
         # compile wave: short generations, pool barely touched

@@ -28,8 +28,15 @@ CHAOS_SEED = "0"  # fixed: policies under test derive jitter from seed=0
 # anything: a renamed marker or module would otherwise silently shrink the
 # suite to zero relevant tests while the gate stays green. test_sync_pipeline
 # carries the pipelined-upload chaos tests (worker killed mid-broadcast must
-# degrade without wedging the producer queue — ISSUE 4).
-REQUIRED_CHAOS_MODULES = ("test_resilience_chaos", "test_sync_pipeline")
+# degrade without wedging the producer queue — ISSUE 4); test_engine_dispatch
+# carries the overlapped-serving-loop failure ladder (a mid-window decode or
+# readback fault must fail every in-flight chunk and rebuild the pool —
+# ISSUE 5).
+REQUIRED_CHAOS_MODULES = (
+    "test_resilience_chaos",
+    "test_sync_pipeline",
+    "test_engine_dispatch",
+)
 
 
 def run_chaos_suite(run_idx: int, extra_args: list[str]) -> dict[str, str]:

@@ -1,0 +1,402 @@
+"""Pinned equivalence + chaos suite for the overlapped serving loop
+(inference/dispatch.py — ISSUE 5).
+
+The dispatch-ahead window must be INVISIBLE in outputs: byte-identical
+token streams vs the serial reference loop (``dispatch_depth=1``) across
+randomized admit/EOS/sampling traces and under pool-pressure preemption
+(greedy). The chaos-marked cases pin the failure ladder: a mid-window
+decode failure fails every in-flight chunk's request and the pool
+recovers for fresh traffic. Satellites pinned here too: the rotating
+prefill cursor and the event-driven (Condition-based) Request.stream.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from devspace_tpu.inference import InferenceEngine, Request
+from devspace_tpu.inference.dispatch import resolve_dispatch_depth
+from devspace_tpu.models import transformer as tfm
+
+CFG = tfm.TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def reference_generate(params, prompt_ids, n):
+    prompt = jnp.asarray([prompt_ids], dtype=jnp.int32)
+    out = tfm.generate(params, prompt, CFG, max_new_tokens=n)
+    return [int(t) for t in out[0]]
+
+
+def run_trace(params, depth, reqs, **engine_kwargs):
+    """Serve ``reqs`` (submitted up-front, in order) at the given window
+    depth; returns (results, errors, stats)."""
+    engine = InferenceEngine(
+        params, CFG, dispatch_depth=depth, **engine_kwargs
+    ).start()
+    outs, errs = [], []
+    try:
+        handles = [engine.submit(**r) for r in reqs]
+        for h in handles:
+            try:
+                outs.append(h.result(timeout=600))
+                errs.append(None)
+            except RuntimeError as e:
+                outs.append(None)
+                errs.append(str(e))
+        st = engine.stats()
+    finally:
+        engine.stop()
+    return outs, errs, st
+
+
+# -- equivalence: overlapped vs serial ------------------------------------
+def test_overlap_matches_serial_mixed_trace(params):
+    """Tier-1 equivalence core: a compact greedy/sampled/EOS mix must
+    stream byte-identically at depth 2 vs the serial loop, and the new
+    overlap stats must surface with sane values. (The 10-request
+    randomized matrix, depth 4, preemption and spec A/Bs run in the full
+    suite — slow-marked below.)"""
+    prompt = [5, 1, 4, 9]
+    eos_ref = reference_generate(params, prompt, 8)
+    reqs = [
+        dict(prompt_ids=[2, 3, 4], max_new_tokens=8),
+        dict(
+            prompt_ids=[9, 8], max_new_tokens=7,
+            temperature=0.8, seed=3, top_k=8,
+        ),
+        dict(prompt_ids=prompt, max_new_tokens=8, eos_id=int(eos_ref[2])),
+    ]
+    kw = dict(max_slots=3, max_len=32, chunk_max=4)
+    serial = run_trace(params, 1, reqs, **kw)
+    overlap = run_trace(params, 2, reqs, **kw)
+    assert all(e is None for e in serial[1] + overlap[1])
+    assert overlap[0] == serial[0], "window depth changed a token stream"
+    assert serial[0][0] == reference_generate(params, [2, 3, 4], 8)
+    # overlap observability (satellite d): new stats keys, sane values
+    st = overlap[2]
+    assert st["dispatch_depth"] == 2
+    assert st["decode_dispatches"] >= 1
+    assert st["carry_updates"] >= 1
+    assert 0.0 < st["dispatch_depth_occupancy"] <= 2.0
+    assert st["readback_wait_s"] >= 0.0
+    assert st["host_sched_s"] >= 0.0
+
+
+@pytest.mark.slow
+def test_overlap_matches_serial_randomized_traces(params):
+    """Randomized admit/EOS/sampling mix (greedy, temperature, top-k,
+    mid-stream EOS learned from the greedy reference, min_new_tokens):
+    depth-2 streams must equal depth-1 streams token-for-token, and the
+    plain greedy requests must equal the standalone reference."""
+    rng = np.random.default_rng(7)
+    reqs = []
+    for t in range(10):
+        plen = int(rng.integers(1, 24))
+        n = int(rng.integers(2, 14))
+        prompt = [int(x) for x in rng.integers(1, CFG.vocab_size, size=plen)]
+        r = dict(prompt_ids=prompt, max_new_tokens=n)
+        mode = t % 3
+        if mode == 1:
+            r.update(
+                temperature=0.8, seed=t, top_k=int(rng.integers(0, 8))
+            )
+        elif mode == 2:
+            # an EOS that actually fires mid-stream in the greedy run
+            ref = reference_generate(params, prompt, n)
+            r.update(eos_id=int(ref[min(2, len(ref) - 1)]))
+        if t % 4 == 3:
+            r.update(min_new_tokens=2)
+        reqs.append(r)
+    serial = run_trace(params, 1, reqs, max_slots=3, max_len=64)
+    overlap = run_trace(params, 2, reqs, max_slots=3, max_len=64)
+    deep = run_trace(params, 4, reqs, max_slots=3, max_len=64)
+    assert all(e is None for e in serial[1] + overlap[1] + deep[1])
+    assert overlap[0] == serial[0], "window depth changed a token stream"
+    assert deep[0] == serial[0], "deeper window changed a token stream"
+    for r, got in zip(reqs, serial[0]):
+        if (
+            not r.get("temperature")
+            and "eos_id" not in r
+            and "min_new_tokens" not in r
+        ):
+            assert got == reference_generate(
+                params, r["prompt_ids"], r["max_new_tokens"]
+            )
+    # overlap observability rides the same trace (satellite d): the new
+    # stats surface with sane values at depth 2
+    st = overlap[2]
+    assert st["dispatch_depth"] == 2
+    assert deep[2]["dispatch_depth"] == 4
+    assert st["decode_dispatches"] >= 1
+    assert st["carry_updates"] >= 1
+    assert 0.0 < st["dispatch_depth_occupancy"] <= 2.0
+    assert st["readback_wait_s"] >= 0.0
+    assert st["host_sched_s"] >= 0.0
+
+
+@pytest.mark.slow
+def test_overlap_matches_serial_under_preemption(params):
+    """Oversubscribed pool: the preemption ladder must fire in both
+    loops, and the (greedy) recompute-preemption streams must match both
+    the serial run and the standalone reference — the overlapped ladder
+    drains the in-flight window before evicting anything. Config mirrors
+    test_paged_pool_preemption_and_recovery: 9 usable blocks vs two
+    co-resident 40+-position sequences guarantees contention, and these
+    trajectories are known tie-free at 40 tokens."""
+    p1, p2 = [2, 3, 4, 5], [9, 8, 7]
+    reqs = [
+        dict(prompt_ids=p, max_new_tokens=40) for p in (p1, p2, p1, p2)
+    ]
+    kw = dict(
+        max_slots=2, max_len=64, block_size=8, n_blocks=10, prefill_chunk=8
+    )
+    serial = run_trace(params, 1, reqs, **kw)
+    overlap = run_trace(params, 2, reqs, **kw)
+    assert all(e is None for e in serial[1] + overlap[1])
+    assert overlap[0] == serial[0]
+    assert overlap[2]["requests_preempted"] >= 1, (
+        "trace did not exercise pool pressure"
+    )
+    for r, got in zip(reqs, serial[0]):
+        assert got == reference_generate(
+            params, r["prompt_ids"], r["max_new_tokens"]
+        )
+
+
+@pytest.mark.slow
+def test_overlap_with_speculative_engine(params):
+    """Spec rounds interleave with the window (drain-before-spec):
+    greedy speculative decoding stays lossless at depth 2. Slow-marked
+    (draft jits compile): tier-1 still covers spec-through-the-window via
+    test_inference.py's spec tests, which run at the default depth."""
+    reqs = [
+        dict(prompt_ids=[5, 1, 4], max_new_tokens=10),
+        dict(prompt_ids=[2, 2, 2, 2], max_new_tokens=8),
+    ]
+    kw = dict(
+        max_slots=2, max_len=64, draft_params=params, draft_cfg=CFG, spec_k=3
+    )
+    serial = run_trace(params, 1, reqs, **kw)
+    overlap = run_trace(params, 2, reqs, **kw)
+    assert overlap[0] == serial[0]
+    for r, got in zip(reqs, serial[0]):
+        assert got == reference_generate(
+            params, r["prompt_ids"], r["max_new_tokens"]
+        )
+
+
+def test_zombie_slot_blocks_freed_after_window_drain(params):
+    """A slot that finishes (EOS) while later chunks are still in flight
+    becomes a zombie: its blocks must be released once the window drains,
+    and the slot must be re-admittable — no leaks, next request exact."""
+    prompt = [5, 9, 2]
+    ref = reference_generate(params, prompt, 24)
+    eos = ref[2]  # fires mid-chunk with dispatch-ahead chunks in flight
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, dispatch_depth=2
+    )
+    h1 = engine.submit(prompt, 24, eos_id=eos)
+    h2 = engine.submit([3, 3], 4)
+    engine.start()
+    try:
+        assert h1.result(timeout=300) == ref[: ref.index(eos) + 1]
+        assert h2.result(timeout=300) == reference_generate(params, [3, 3], 4)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert st["free_blocks"] == st["total_blocks"], "zombie leaked blocks"
+    assert engine._dispatcher.in_flight == 0
+    assert not engine._dispatcher.pending_free
+
+
+# -- satellites: prefill rotation, stream condition, knobs ----------------
+def test_prefill_round_robin_rotation(params, monkeypatch):
+    """Pinned: the prefill pick rotates over prefilling slots instead of
+    always taking prefilling[0] (which starved high-index admissions)."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=3, max_len=64, prefill_chunk=4
+    )
+    order = []
+    orig = engine._prefill_one_chunk
+
+    def spy(i):
+        order.append(i)
+        return orig(i)
+
+    monkeypatch.setattr(engine, "_prefill_one_chunk", spy)
+    prompts = [
+        [
+            int(x)
+            for x in np.random.default_rng(i).integers(
+                1, CFG.vocab_size, size=16
+            )
+        ]
+        for i in range(3)
+    ]
+    handles = [engine.submit(p, 2) for p in prompts]
+    engine.start()
+    try:
+        for h in handles:
+            h.result(timeout=300)
+    finally:
+        engine.stop()
+    # 16-token prompts at prefill_chunk=4 -> 4 chunks each, all three
+    # admitted before the first chunk: picks must rotate 0,1,2,0,1,2,...
+    assert order[:12] == [0, 1, 2] * 4, f"prefill starved: {order[:12]}"
+
+
+def test_stream_is_event_driven_and_keeps_timeout_semantics():
+    # stalled generation: stream(timeout=...) still raises TimeoutError
+    req = Request(prompt_ids=[1], max_new_tokens=4)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        next(req.stream(timeout=0.2))
+    assert 0.1 < time.monotonic() - t0 < 5.0
+
+    # a blocked consumer wakes on notify, with emit gaps far beyond the
+    # old 20ms poll — tokens arrive in order and the stream terminates
+    req2 = Request(prompt_ids=[1], max_new_tokens=3)
+
+    def feed():
+        for t in (11, 22, 33):
+            time.sleep(0.05)
+            req2.tokens.append(t)
+            req2._notify()
+        req2.done.set()
+        req2._notify()
+
+    th = threading.Thread(target=feed)
+    th.start()
+    got = list(req2.stream(timeout=5))
+    th.join()
+    assert got == [11, 22, 33]
+
+    # error propagation: available tokens first, then the failure
+    req3 = Request(prompt_ids=[1], max_new_tokens=3)
+    req3.tokens.append(7)
+    req3.error = "boom"
+    req3.done.set()
+    req3._notify()
+    it = req3.stream(timeout=1)
+    assert next(it) == 7
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_stream_through_engine_delivers_all_tokens(params):
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, dispatch_depth=2
+    ).start()
+    try:
+        h = engine.submit([5, 1, 4], 9)
+        streamed = list(h.stream(timeout=120))
+        assert streamed == h.result(timeout=1)
+    finally:
+        engine.stop()
+
+
+def test_overlap_env_escape_hatch(params, monkeypatch):
+    monkeypatch.setenv("DEVSPACE_ENGINE_OVERLAP", "off")
+    assert resolve_dispatch_depth(None) == 1
+    eng = InferenceEngine(params, CFG, max_slots=1, max_len=32)
+    assert eng.dispatch_depth == 1
+    monkeypatch.delenv("DEVSPACE_ENGINE_OVERLAP")
+    assert resolve_dispatch_depth(None) == 2
+    monkeypatch.setenv("DEVSPACE_ENGINE_OVERLAP", "3")
+    assert resolve_dispatch_depth(None) == 3
+    assert resolve_dispatch_depth(4) == 4  # explicit arg wins
+    with pytest.raises(ValueError):
+        InferenceEngine(params, CFG, max_slots=1, max_len=32, dispatch_depth=0)
+
+
+# -- chaos: mid-window failure + recovery ---------------------------------
+@pytest.mark.chaos
+def test_chaos_mid_window_decode_failure_fails_all_in_flight(params):
+    """Counter-based fault on the SECOND decode dispatch: at that point
+    chunk 1 is still in flight — the whole window must be abandoned
+    (both slot-resident requests fail, nothing reads the poisoned
+    futures), the pool must rebuild, and fresh traffic must serve
+    exactly. Deterministic: both requests are queued before start."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, dispatch_depth=2
+    )
+    calls = {"n": 0}
+
+    def wrap(fn):
+        def inner(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("injected decode fault")
+            return fn(*a, **k)
+
+        return inner
+
+    engine._decode_chunk = {
+        key: wrap(fn) for key, fn in engine._decode_chunk.items()
+    }
+    h1 = engine.submit([5, 1, 4], 24)
+    h2 = engine.submit([2, 9], 24)
+    engine.start()
+    try:
+        with pytest.raises(RuntimeError, match="decode failed"):
+            h1.result(timeout=300)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            h2.result(timeout=300)
+        h3 = engine.submit([7, 7, 7], 6)
+        got = h3.result(timeout=300)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert got == reference_generate(params, [7, 7, 7], 6)
+    assert st["requests_failed"] == 2
+    assert st["requests_completed"] == 1
+    assert st["free_blocks"] == st["total_blocks"]
+    assert engine._dispatcher.in_flight == 0
+    assert not engine._dispatcher.pending_free
+
+
+@pytest.mark.chaos
+def test_chaos_readback_failure_recovers_pool(params, monkeypatch):
+    """Async dispatch surfaces device errors at READBACK: fail the
+    second drain's device_get. The window (chunk 3 in flight) is
+    abandoned, the resident request fails with the decode-failed ladder,
+    and a fresh request completes on the rebuilt pool."""
+    import devspace_tpu.inference.dispatch as dispatch_mod
+
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, dispatch_depth=2
+    )
+    h1 = engine.submit([5, 1, 4], 24)
+    real = jax.device_get
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected readback fault")
+        return real(x)
+
+    monkeypatch.setattr(dispatch_mod.jax, "device_get", flaky)
+    engine.start()
+    try:
+        with pytest.raises(RuntimeError, match="decode failed"):
+            h1.result(timeout=300)
+        h2 = engine.submit([3, 3], 5)
+        got = h2.result(timeout=300)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert got == reference_generate(params, [3, 3], 5)
+    assert st["requests_failed"] == 1
+    assert st["requests_completed"] == 1
+    assert st["free_blocks"] == st["total_blocks"]
+    assert engine._dispatcher.in_flight == 0
