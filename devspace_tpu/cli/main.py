@@ -290,8 +290,87 @@ def cmd_analyze(args) -> int:
 
 
 # -- status -----------------------------------------------------------------
+def _status_serving(args) -> int:
+    """Render a running inference server's telemetry snapshot: engine
+    stats from /healthz plus the recent-request ring from /debug/requests
+    (examples/llama-inference/serve.py; ISSUE 6)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    url = args.url.rstrip("/")
+
+    def fetch(path):
+        with urllib.request.urlopen(url + path, timeout=5) as resp:
+            return _json.loads(resp.read())
+
+    try:
+        health = fetch("/healthz")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        log.error("no serving endpoint at %s: %s", url, e)
+        return 1
+    stat_keys = [
+        ("model", "model"),
+        ("active_slots", "active slots"),
+        ("queued", "queued"),
+        ("requests_completed", "completed"),
+        ("requests_failed", "failed"),
+        ("requests_preempted", "preempted"),
+        ("tokens_generated", "tokens"),
+        ("tokens_per_sec", "tok/s (lifetime)"),
+        ("tokens_per_sec_10s", "tok/s (10s)"),
+        ("free_blocks", "free kv blocks"),
+        ("uptime_s", "uptime (s)"),
+    ]
+    log.print_table(
+        ["STAT", "VALUE"],
+        [[label, str(health.get(k, "-"))] for k, label in stat_keys],
+    )
+    try:
+        debug = fetch("/debug/requests")
+    except (urllib.error.URLError, OSError, ValueError):
+        debug = None
+    if debug is None:
+        log.warn("no /debug/requests endpoint at %s (older server?)", url)
+        return 0
+    if not debug.get("metrics_enabled", False):
+        log.warn("metrics disabled on the server (DEVSPACE_ENGINE_METRICS=off)")
+        return 0
+
+    def ms(v):
+        return f"{v * 1000:.1f}ms" if v is not None else "-"
+
+    rows = [
+        [
+            str(r.get("id", "?")),
+            r.get("outcome") or "in-flight",
+            str(r.get("prompt_len", "-")),
+            str(r.get("tokens_generated", 0)),
+            ms(r.get("queue_wait_s")),
+            ms(r.get("ttft_s")),
+            ms(r.get("tpot_s")),
+            ms(r.get("e2e_s")),
+            str(r.get("preemptions", 0)),
+        ]
+        for r in (debug.get("requests") or [])[-15:]
+    ]
+    log.print_table(
+        ["REQ", "OUTCOME", "PROMPT", "TOKENS", "QUEUE", "TTFT", "TPOT", "E2E", "PREEMPTS"],
+        rows,
+    )
+    return 0
+
+
 def cmd_status(args) -> int:
     """Reference: cmd/status/{deployments,sync}.go."""
+    if args.what == "serving":
+        # Scrapes a RUNNING server (the llama-inference example) over
+        # HTTP — needs --url, not a project config, so this branch runs
+        # before Context() (which requires devspace.yaml).
+        return _status_serving(args)
     ctx = Context(args)
     log = ctx.log
     if args.what == "deployments":
@@ -349,6 +428,18 @@ def cmd_status(args) -> int:
             for s in spans[-30:]
         ]
         log.print_table(["SPAN", "DURATION", "RESULT", "PARENT"], rows)
+        if len(spans) > 30:
+            log.info(
+                "[trace] showing 30 of %d spans (full trace in "
+                ".devspace/logs/trace.jsonl)",
+                len(spans),
+            )
+        if trace.dropped():
+            log.warn(
+                "[trace] %d span(s) evicted from the in-memory ring "
+                "(trace_spans_dropped_total)",
+                trace.dropped(),
+            )
     else:  # sync — structured status file + sync.log scrape fallback
         import json as _json
         import time as _time
@@ -1480,9 +1571,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--all", action="store_true", help="also remove chart/ and Dockerfile")
     sp.set_defaults(fn=cmd_reset)
 
-    sp = sub.add_parser("status", help="deployment / sync / trace status")
-    sp.add_argument("what", choices=["deployments", "sync", "trace"])
+    sp = sub.add_parser("status", help="deployment / sync / trace / serving status")
+    sp.add_argument("what", choices=["deployments", "sync", "trace", "serving"])
     sp.add_argument("--export", help="(trace) write chrome://tracing JSON here")
+    sp.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="(serving) base URL of a running inference server",
+    )
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("add", help="add config entries")

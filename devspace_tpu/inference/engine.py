@@ -60,8 +60,72 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer as tfm
+from ..obs.metrics import Registry, WindowedRate, metrics_enabled
+from ..obs.request_trace import ServingTelemetry
 from .dispatch import DecodeDispatcher, resolve_dispatch_depth
 from .prefix_cache import RadixPrefixCache
+
+# Metric families the engine registers over its serving counters
+# (pull-style: each callback reads the same ints stats() reports — ONE
+# mutation site, two views; scripts/metrics_lint.py checks the names).
+# Format: (name, kind, help, stats_key).
+ENGINE_METRIC_FAMILIES = (
+    ("engine_requests_completed_total", "counter",
+     "Requests that finished successfully", "requests_completed"),
+    ("engine_requests_failed_total", "counter",
+     "Requests that failed (dispatch faults, bad admissions, stop())",
+     "requests_failed"),
+    ("engine_requests_preempted_total", "counter",
+     "Preemption events (a request may be preempted more than once)",
+     "requests_preempted"),
+    ("engine_tokens_generated_total", "counter",
+     "Generated tokens emitted across all requests", "tokens_generated"),
+    ("engine_prefix_hit_blocks_total", "counter",
+     "Prompt blocks served from the radix prefix cache at admission",
+     "prefix_hit_blocks"),
+    ("engine_decode_dispatches_total", "counter",
+     "Decode chunks dispatched by the overlapped serving loop",
+     "decode_dispatches"),
+    ("engine_readback_wait_seconds_total", "counter",
+     "Host time blocked on decode token readback", "readback_wait_s"),
+    ("engine_spec_rounds_total", "counter",
+     "Speculative draft/verify rounds replayed by the host commit loop",
+     "spec_rounds"),
+    ("engine_spec_proposed_total", "counter",
+     "Draft tokens proposed in replayed speculative rounds",
+     "spec_proposed"),
+    ("engine_spec_accepted_total", "counter",
+     "Draft tokens accepted by target verification", "spec_accepted"),
+    ("engine_spec_committed_total", "counter",
+     "Tokens committed from speculative rounds", "spec_committed"),
+    ("engine_active_slots", "gauge",
+     "Slots currently decoding (prefill complete)", "active_slots"),
+    ("engine_prefilling_slots", "gauge",
+     "Slots currently in chunked prefill", "prefilling_slots"),
+    ("engine_max_slots", "gauge",
+     "Configured concurrent-sequence capacity", "max_slots"),
+    ("engine_queued_requests", "gauge",
+     "Requests waiting for a slot (pending queue + preempted resume list)",
+     "queued"),
+    ("engine_free_kv_blocks", "gauge",
+     "Unallocated KV pool blocks", "free_blocks"),
+    ("engine_kv_blocks", "gauge",
+     "Allocatable KV pool blocks (excludes the scratch block)",
+     "total_blocks"),
+    ("engine_prefix_cached_blocks", "gauge",
+     "Blocks currently published in the radix prefix cache",
+     "prefix_cached_blocks"),
+    ("engine_dispatch_depth", "gauge",
+     "Configured dispatch-ahead window depth", "dispatch_depth"),
+    ("engine_dispatch_depth_occupancy", "gauge",
+     "Mean in-flight window depth observed at dispatch",
+     "dispatch_depth_occupancy"),
+    ("engine_uptime_seconds", "gauge",
+     "Seconds since the scheduler thread started", "uptime_s"),
+    ("engine_tokens_per_sec_10s", "gauge",
+     "Generated tokens per second over the last ~10s window",
+     "tokens_per_sec_10s"),
+)
 
 
 def sample_logits(key, logits, temperature, top_k=0, top_p=1.0):
@@ -210,6 +274,8 @@ class InferenceEngine:
         prefix_cache: bool = True,
         prewarm: bool = False,
         dispatch_depth: Optional[int] = None,
+        metrics: Optional[bool] = None,
+        metrics_registry: Optional[Registry] = None,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
@@ -276,7 +342,18 @@ class InferenceEngine:
         scheduling/emit work overlaps device compute; depth 1 is the
         serial reference loop (escape hatch:
         ``DEVSPACE_ENGINE_OVERLAP=off``). Token streams are identical at
-        every depth (pinned by tests/test_engine_dispatch.py)."""
+        every depth (pinned by tests/test_engine_dispatch.py).
+
+        ``metrics`` turns the telemetry subsystem (obs/) on or off:
+        default ON, escape hatch ``DEVSPACE_ENGINE_METRICS=off`` (the
+        bench.py overhead A/B). When on, ``self.telemetry`` records
+        per-request lifecycle traces and latency histograms
+        (TTFT/TPOT/queue-wait/prefill/e2e) and the engine's serving
+        counters are registered as Prometheus metric families in
+        ``self.metrics_registry`` (a PRIVATE obs.metrics.Registry unless
+        ``metrics_registry`` shares one). ``stats()`` keys are unchanged
+        either way — the registry and stats() are two views over the
+        same counters."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -449,6 +526,19 @@ class InferenceEngine:
         self.requests_failed = 0
         self.requests_preempted = 0
         self.tokens_generated = 0
+        # windowed token rate (ISSUE 6 satellite): tokens_per_sec is a
+        # lifetime average that goes stale after idle periods; the 10s
+        # window decays to 0 when traffic stops. Always on — one clock
+        # read per emitted token.
+        self._tok_rate = WindowedRate(10.0)
+        # telemetry (obs/): per-request lifecycle traces + latency
+        # histograms + the engine's counters as metric families. None
+        # when disabled (DEVSPACE_ENGINE_METRICS=off / metrics=False);
+        # every hook site is guarded so the off path costs one None check
+        self.telemetry: Optional[ServingTelemetry] = None
+        if metrics_enabled(metrics):
+            self.telemetry = ServingTelemetry(metrics_registry)
+            self._register_metric_families()
         self._stop = threading.Event()
         # serializes submit's check+put against stop's set+drain, closing
         # the window where a request lands in the queue after the drain
@@ -822,10 +912,19 @@ class InferenceEngine:
             min_new_tokens=int(min_new_tokens),
             logit_bias=logit_bias,
         )
-        with self._submit_lock:
-            if self._stop.is_set():
-                raise RuntimeError("engine is stopped")
-            self.pending.put(req)
+        # trace BEFORE the queue put: the scheduler may admit the request
+        # the instant it lands, and on_admit is a no-op without the trace
+        if self.telemetry is not None:
+            self.telemetry.on_submit(req)
+        try:
+            with self._submit_lock:
+                if self._stop.is_set():
+                    raise RuntimeError("engine is stopped")
+                self.pending.put(req)
+        except BaseException:
+            if self.telemetry is not None:
+                self.telemetry.on_finish(req, "failed")
+            raise
         return req
 
     def start(self) -> "InferenceEngine":
@@ -963,6 +1062,9 @@ class InferenceEngine:
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
             if uptime > 0
             else 0.0,
+            # windowed rate alongside the lifetime average (which goes
+            # stale after idle periods — kept for compatibility)
+            "tokens_per_sec_10s": round(self._tok_rate.rate(), 2),
             "spec_rounds": self.spec_rounds,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
@@ -978,6 +1080,41 @@ class InferenceEngine:
             # packed carry refreshes the slot churn actually cost
             **self._dispatcher.stats(),
         }
+
+    # -- metrics (obs/) ----------------------------------------------------
+    @property
+    def metrics_registry(self) -> Optional[Registry]:
+        """The engine's metric registry (None with metrics disabled)."""
+        return self.telemetry.registry if self.telemetry is not None else None
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of this engine's registry (serving
+        counters + request-latency histograms); "" when disabled."""
+        reg = self.metrics_registry
+        return reg.render() if reg is not None else ""
+
+    def _register_metric_families(self) -> None:
+        """Register ENGINE_METRIC_FAMILIES as pull-style callbacks over
+        stats() — the counters keep their single mutation site, the
+        registry reads them at scrape time. Weakref'd so a registry that
+        outlives the engine (shared ``metrics_registry``) reports 0
+        instead of pinning the engine (and its device buffers) alive."""
+        import weakref
+
+        reg = self.telemetry.registry
+        ref = weakref.ref(self)
+
+        def reader(key):
+            def fn():
+                eng = ref()
+                if eng is None:
+                    return 0.0
+                return float(eng.stats().get(key, 0) or 0)
+
+            return fn
+
+        for name, kind, help_, key in ENGINE_METRIC_FAMILIES:
+            reg.register_callback(name, kind, help_, reader(key))
 
     def stop(self) -> None:
         """Stop the scheduler and fail out any unfinished requests so no
@@ -1128,12 +1265,16 @@ class InferenceEngine:
                 continue  # completed concurrently — don't double-count
             req.error = reason
             self.requests_failed += 1
+            if self.telemetry is not None:
+                self.telemetry.on_finish(req, "failed")
             self._finish(req)  # done LAST (see _emit)
         if not drain_queue:
             return
         for req in self._resume:
             req.error = reason
             self.requests_failed += 1
+            if self.telemetry is not None:
+                self.telemetry.on_finish(req, "failed")
             self._finish(req)  # done LAST (see _emit)
         self._resume.clear()
         while True:
@@ -1143,6 +1284,8 @@ class InferenceEngine:
                 break
             req.error = reason
             self.requests_failed += 1
+            if self.telemetry is not None:
+                self.telemetry.on_finish(req, "failed")
             self._finish(req)  # done LAST (see _emit)
 
     def _recover_pool_if_lost(self) -> None:
@@ -1256,6 +1399,8 @@ class InferenceEngine:
         slot.remaining = req.max_new_tokens - len(req.tokens)
         slot.admitted_at = time.monotonic()
         self._sync_sampling_extras(slot_idx, req)
+        if self.telemetry is not None:
+            self.telemetry.on_admit(req)
         return True
 
     def _sync_sampling_extras(self, slot_idx: int, req: Request) -> None:
@@ -1334,6 +1479,8 @@ class InferenceEngine:
         )
         slot.prefill_pos = offset + real
         self._publish_prefix_blocks(slot_idx)
+        if self.telemetry is not None:
+            self.telemetry.on_prefill_chunk(req, slot.prefill_pos)
         if slot.prefill_pos >= t:
             # prefill complete: first token from the last REAL position
             key = jax.random.PRNGKey(req.seed)
@@ -1367,6 +1514,8 @@ class InferenceEngine:
                 # become eligible later, so theirs pays off
                 self._draft_prefill(slot_idx)
             slot.ready = True
+            if self.telemetry is not None:
+                self.telemetry.on_prefill_done(req)
             self._emit(slot_idx, int(first))
             # host is authoritative for this slot's carry row until its
             # first decode dispatch re-uploads it
@@ -1440,6 +1589,8 @@ class InferenceEngine:
         self._free_slot_blocks(i)
         self._resume.append(req)
         self.requests_preempted += 1
+        if self.telemetry is not None:
+            self.telemetry.on_preempt(req)
 
     def _emit(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]
@@ -1447,6 +1598,9 @@ class InferenceEngine:
         req.tokens.append(token)
         req._notify()  # wake stream() consumers (event-driven delivery)
         self.tokens_generated += 1
+        self._tok_rate.add(1)
+        if self.telemetry is not None:
+            self.telemetry.on_emit(req)
         slot.last_token = token
         slot.length += 1
         slot.remaining -= 1
@@ -1481,6 +1635,8 @@ class InferenceEngine:
             slot.ready = False
             self._retire_slot(slot_idx)
             self.requests_completed += 1
+            if self.telemetry is not None:
+                self.telemetry.on_finish(req, "completed")
             # done LAST: result()/stats() callers wake on it and must see
             # the counters and the freed blocks already settled
             self._finish(req)
@@ -1530,6 +1686,8 @@ class InferenceEngine:
                 self._free_slot_blocks(i)
                 self.slots[i].req = None
                 self.requests_failed += 1
+                if self.telemetry is not None:
+                    self.telemetry.on_finish(req, "failed")
                 self._recover_pool_if_lost()
                 self._finish(req)  # done LAST (see _emit)
 
@@ -1634,6 +1792,8 @@ class InferenceEngine:
                     if req is not None:
                         req.error = str(e)
                         self.requests_failed += 1
+                        if self.telemetry is not None:
+                            self.telemetry.on_finish(req, "failed")
                     self._recover_pool_if_lost()
                     self._reset_draft_cache()  # draft prefill may have died
                     if req is not None:

@@ -1,0 +1,43 @@
+"""Unified telemetry (ISSUE 6): metrics registry + per-request traces.
+
+- :mod:`devspace_tpu.obs.metrics` — dependency-free Counter / Gauge /
+  Histogram registry with labeled families, callback (pull) metrics and
+  Prometheus text-exposition rendering.
+- :mod:`devspace_tpu.obs.request_trace` — per-request serving lifecycle
+  recorder producing TTFT / TPOT / queue-wait / prefill / e2e
+  histograms and a bounded ring of recent request traces.
+
+Every serving subsystem registers its counters here as metric families;
+the existing ``stats()`` dicts stay byte-compatible (they and the
+registry are two views over the same counters).
+"""
+
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    WindowedRate,
+    get_registry,
+    metrics_enabled,
+)
+from .request_trace import (
+    SERVING_METRIC_FAMILIES,
+    RequestTrace,
+    ServingTelemetry,
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "WindowedRate",
+    "get_registry",
+    "metrics_enabled",
+    "SERVING_METRIC_FAMILIES",
+    "RequestTrace",
+    "ServingTelemetry",
+]

@@ -22,6 +22,39 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from ..obs.metrics import get_registry
+
+# (name, kind, help) — lintable catalog (scripts/metrics_lint.py). These
+# are process-wide direct counters (not per-instance): policies and
+# breakers are cheap throwaway objects, so the aggregate is the useful
+# signal and the counters live in the default registry.
+RESILIENCE_METRIC_FAMILIES = (
+    (
+        "resilience_retry_attempts_total",
+        "counter",
+        "Backoff waits taken before retrying a failed operation",
+    ),
+    (
+        "resilience_retries_exhausted_total",
+        "counter",
+        "Operations abandoned after exhausting retry attempts or deadline",
+    ),
+    (
+        "resilience_circuit_open_total",
+        "counter",
+        "Circuit-breaker transitions into the open state",
+    ),
+)
+
+def _counter(idx: int):
+    name, _kind, help_ = RESILIENCE_METRIC_FAMILIES[idx]
+    return get_registry().counter(name, help_)
+
+
+_retry_attempts = _counter(0)
+_retries_exhausted = _counter(1)
+_circuit_open = _counter(2)
+
 
 class RetryExhausted(Exception):
     """All attempts failed; ``last`` carries the final exception."""
@@ -93,6 +126,7 @@ class RetryPolicy:
             except StopIteration:
                 break
             if self.deadline is not None and clock() - start + delay > self.deadline:
+                _retries_exhausted.inc()
                 if reraise:
                     raise last
                 raise RetryExhausted(
@@ -103,7 +137,9 @@ class RetryPolicy:
                 ) from last
             if on_retry is not None:
                 on_retry(attempt, last, delay)
+            _retry_attempts.inc()
             sleep(delay)
+        _retries_exhausted.inc()
         if reraise:
             raise last
         raise RetryExhausted(
@@ -193,11 +229,13 @@ class CircuitBreaker:
                 # failed probe: straight back to open, timer restarts
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+                _circuit_open.inc()
                 return
             self._failures += 1
             if self._failures >= self.failure_threshold:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+                _circuit_open.inc()
 
     def call(self, fn: Callable, *args, **kwargs):
         """Run ``fn`` under the breaker; raises :class:`CircuitOpenError`

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
@@ -165,6 +166,47 @@ class SyncOptions:
     pipeline_depth: int = 3
 
 
+# (name, kind, help, stats_key) — lintable catalog (scripts/metrics_lint.py).
+# Registered once as pull-style callbacks that aggregate over every live
+# session: the stats dict stays the single mutation site ("two views, one
+# truth") and `status sync` output is untouched.
+SYNC_METRIC_FAMILIES = (
+    ("sync_uploaded_total", "counter", "Files uploaded to workers", "uploaded"),
+    ("sync_downloaded_total", "counter", "Files mirrored back from workers", "downloaded"),
+    ("sync_removed_local_total", "counter", "Local files removed by downstream mirroring", "removed_local"),
+    ("sync_removed_remote_total", "counter", "Remote files removed by upstream mirroring", "removed_remote"),
+    ("sync_repaired_total", "counter", "Files re-pushed by the verify/repair loop", "repaired"),
+    ("sync_sent_bytes_total", "counter", "Payload bytes broadcast to workers", "bytes_sent"),
+    ("sync_meta_fixes_total", "counter", "Metadata-only fixes (mtime/mode) applied remotely", "meta_fixes"),
+    ("sync_saved_digest_bytes_total", "counter", "Upload bytes avoided by digest gating", "bytes_saved_digest"),
+    ("sync_pipeline_stall_seconds_total", "counter", "Producer time blocked on full per-worker send queues", "pipeline_stall_s"),
+    ("sync_workers_quarantined_total", "counter", "Workers dropped from the fan-out after unrecoverable errors", "workers_quarantined"),
+)
+
+# Live sessions for the aggregate metric callbacks — weak so the registry
+# never pins a stopped session.
+_LIVE_SESSIONS: "weakref.WeakSet[SyncSession]" = weakref.WeakSet()
+
+
+def _register_sync_metrics() -> None:
+    try:
+        from ..obs.metrics import get_registry
+
+        reg = get_registry()
+        for name, kind, help_, key in SYNC_METRIC_FAMILIES:
+
+            def fn(key=key):
+                total = 0.0
+                for s in list(_LIVE_SESSIONS):
+                    with s._stats_lock:
+                        total += float(s.stats.get(key, 0) or 0)
+                return total
+
+            reg.register_callback(name, kind, help_, fn)
+    except Exception:  # noqa: BLE001 — metrics are optional here
+        pass
+
+
 class SyncSession:
     def __init__(
         self,
@@ -223,6 +265,8 @@ class SyncSession:
             "meta_fixes": 0,
             "bytes_saved_digest": 0,
             "pipeline_stall_s": 0.0,
+            # workers dropped from the fan-out (observability, ISSUE 6)
+            "workers_quarantined": 0,
         }
         self._stats_lock = threading.Lock()
         self.started_at: Optional[float] = None
@@ -237,6 +281,7 @@ class SyncSession:
         # Rogue paths seen on a worker last pass — removal needs two
         # consecutive sightings (see _verify_worker).
         self._extra_candidates: dict[int, set[str]] = {}
+        _LIVE_SESSIONS.add(self)
 
     # -- paths -------------------------------------------------------------
     def _remote_dir(self, worker) -> str:
@@ -553,6 +598,7 @@ class SyncSession:
             if i in self.worker_errors:
                 return
             self.worker_errors[i] = str(exc)
+        self._bump("workers_quarantined", 1)
         try:
             self._shells[i].close()
         except Exception:  # noqa: BLE001 — already broken
@@ -1159,3 +1205,6 @@ def _batch_entries(entries: list[FileInformation]):
             batch, size = [], 0
     if batch:
         yield batch
+
+
+_register_sync_metrics()

@@ -22,7 +22,17 @@ _lock = threading.Lock()
 _trace_path: Optional[str] = None
 _spans: list[dict] = []  # in-memory ring (also used by `status trace`)
 _MAX_SPANS = 2000
+_spans_dropped = 0  # ring evictions (surfaced by `status trace` + /metrics)
 _tls = threading.local()
+
+# (name, kind, help) — lintable catalog (scripts/metrics_lint.py)
+TRACE_METRIC_FAMILIES = (
+    (
+        "trace_spans_dropped_total",
+        "counter",
+        "Spans evicted from the in-memory ring (oldest-first rotation)",
+    ),
+)
 
 
 def enable(devspace_dir: str) -> None:
@@ -72,9 +82,15 @@ def span(name: str, **attrs: Any) -> Iterator[dict]:
 
 
 def _emit(record: dict) -> None:
+    global _spans_dropped
     with _lock:
         _spans.append(record)
-        del _spans[:-_MAX_SPANS]
+        evicted = len(_spans) - _MAX_SPANS
+        if evicted > 0:
+            # rotate keeping the NEWEST spans; count what fell off so
+            # `status trace` can say the view is partial
+            _spans_dropped += evicted
+            del _spans[:evicted]
         path = _trace_path
     if path:
         try:
@@ -87,6 +103,12 @@ def _emit(record: dict) -> None:
 def recent(limit: int = 50) -> list[dict]:
     with _lock:
         return list(_spans[-limit:])
+
+
+def dropped() -> int:
+    """Spans evicted from the in-memory ring so far (this process)."""
+    with _lock:
+        return _spans_dropped
 
 
 def load(devspace_dir: str) -> list[dict]:
@@ -105,10 +127,10 @@ def load(devspace_dir: str) -> list[dict]:
     return out
 
 
-def export_chrome(devspace_dir: str, dest: str) -> int:
-    """Write a chrome://tracing / Perfetto-compatible trace. Returns the
-    number of events written."""
-    spans = load(devspace_dir)
+def chrome_events(spans: list[dict]) -> list[dict]:
+    """Span dicts -> chrome://tracing ``traceEvents`` (complete events).
+    Shared by the dev-loop trace export and the serving request-trace
+    export (obs/request_trace.py)."""
     events = []
     for s in spans:
         events.append(
@@ -127,6 +149,34 @@ def export_chrome(devspace_dir: str, dest: str) -> int:
                 },
             }
         )
+    return events
+
+
+def write_chrome(spans: list[dict], dest: str) -> int:
+    """Write spans as a chrome://tracing / Perfetto-compatible trace file.
+    Returns the number of events written."""
+    events = chrome_events(spans)
     with open(dest, "w", encoding="utf-8") as fh:
         json.dump({"traceEvents": events}, fh)
     return len(events)
+
+
+def export_chrome(devspace_dir: str, dest: str) -> int:
+    """Write a chrome://tracing / Perfetto-compatible trace. Returns the
+    number of events written."""
+    return write_chrome(load(devspace_dir), dest)
+
+
+def _register_metrics() -> None:
+    # the span ring is a process-wide source, so it reports into the
+    # process-wide default registry (obs.metrics.get_registry)
+    try:
+        from ..obs.metrics import get_registry
+
+        name, kind, help_ = TRACE_METRIC_FAMILIES[0]
+        get_registry().register_callback(name, kind, help_, dropped)
+    except Exception:  # noqa: BLE001 — metrics are optional here
+        pass
+
+
+_register_metrics()

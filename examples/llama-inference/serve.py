@@ -3,7 +3,9 @@ TPU pod, reached through `devspace-tpu dev`'s port-forward and health-checked
 by `devspace-tpu analyze`.
 
 Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
-optional "temperature", "eos_id", "top_k", "top_p"}) and /healthz. Concurrent requests are
+optional "temperature", "eos_id", "top_k", "top_p"}), /healthz, /metrics
+(Prometheus text exposition) and /debug/requests (recent per-request
+serving traces). Concurrent requests are
 continuously batched by devspace_tpu.inference.InferenceEngine
 (iteration-level scheduling — a long generation never blocks a short one).
 Defaults to the TINY config so it runs anywhere; set MODEL=llama2-7b on a
@@ -224,6 +226,31 @@ def main():
                         "ok": True,
                         "model": os.environ.get("MODEL", "tiny"),
                         **server.engine.stats(),
+                    },
+                )
+            elif self.path == "/metrics":
+                # Prometheus text exposition: the engine's private
+                # registry (serving histograms + engine gauges) plus the
+                # process-wide default registry (sync/resilience/trace) —
+                # name prefixes are disjoint, so concatenation is safe.
+                from devspace_tpu.obs import get_registry
+
+                body = (
+                    server.engine.metrics_text() + get_registry().render()
+                ).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/debug/requests":
+                tel = server.engine.telemetry
+                self._json(
+                    200,
+                    {
+                        "metrics_enabled": tel is not None,
+                        "requests": tel.recent(50) if tel is not None else [],
                     },
                 )
             else:

@@ -36,6 +36,9 @@ REQUIRED_CHAOS_MODULES = (
     "test_resilience_chaos",
     "test_sync_pipeline",
     "test_engine_dispatch",
+    # metric consistency under injected failures (ISSUE 6 satellite):
+    # failure counters must increment exactly once per failed unit
+    "test_obs_chaos",
 )
 
 

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Metrics-catalog lint: naming conventions + registrability.
+
+Walks every ``*_METRIC_FAMILIES`` catalog the subsystems export (engine,
+serving telemetry, sync, resilience, trace) and enforces the conventions
+docs/observability.md documents, so a metric can't ship with a name
+Prometheus tooling chokes on or operators can't grep:
+
+- names are snake_case (``[a-z][a-z0-9_]*``)
+- counters end in ``_total``; nothing else may
+- histograms and time/size gauges carry a unit suffix (``_seconds``,
+  ``_bytes``, or an explicit whitelist for unit-less gauges)
+- help strings are nonempty and don't repeat the metric name verbatim
+- no duplicate names across catalogs (the /metrics endpoint concatenates
+  the engine registry with the process-wide one — prefixes must stay
+  disjoint)
+- every family actually registers into a fresh Registry (kind is valid,
+  name passes the registry's own validation)
+
+Exits non-zero on any violation. Usage: python scripts/metrics_lint.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # engine import pulls in jax
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+# Gauges that are plain quantities (slots, blocks, depths, ratios) —
+# names where a unit suffix would be noise, not information.
+_UNITLESS_GAUGE_SUFFIXES = (
+    "_slots",
+    "_blocks",
+    "_requests",
+    "_depth",
+    "_occupancy",
+)
+_RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
+
+
+def load_catalogs() -> dict[str, tuple]:
+    """{catalog label: ((name, kind, help, *rest), ...)} — import order
+    matters only for jax (engine); everything else is dependency-free."""
+    from devspace_tpu.inference.engine import ENGINE_METRIC_FAMILIES
+    from devspace_tpu.obs.request_trace import SERVING_METRIC_FAMILIES
+    from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
+    from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
+    from devspace_tpu.utils.trace import TRACE_METRIC_FAMILIES
+
+    return {
+        "engine": ENGINE_METRIC_FAMILIES,
+        "serving": SERVING_METRIC_FAMILIES,
+        "sync": SYNC_METRIC_FAMILIES,
+        "resilience": RESILIENCE_METRIC_FAMILIES,
+        "trace": TRACE_METRIC_FAMILIES,
+    }
+
+
+def lint(catalogs: dict[str, tuple]) -> list[str]:
+    problems: list[str] = []
+    seen: dict[str, str] = {}
+    for label, families in catalogs.items():
+        for fam in families:
+            name, kind, help_ = fam[0], fam[1], fam[2]
+            where = f"{label}:{name}"
+            if not _NAME_RE.match(name):
+                problems.append(f"{where}: not snake_case")
+            if kind not in ("counter", "gauge", "histogram"):
+                problems.append(f"{where}: unknown kind {kind!r}")
+            if kind == "counter" and not name.endswith("_total"):
+                problems.append(f"{where}: counters must end in _total")
+            if kind != "counter" and name.endswith("_total"):
+                problems.append(f"{where}: _total is reserved for counters")
+            if kind == "histogram" and not name.endswith(_UNIT_SUFFIXES):
+                problems.append(
+                    f"{where}: histograms need a unit suffix "
+                    f"({'/'.join(_UNIT_SUFFIXES)})"
+                )
+            if kind == "gauge" and not (
+                name.endswith(_UNIT_SUFFIXES)
+                or name.endswith(_UNITLESS_GAUGE_SUFFIXES)
+                or _RATE_RE.search(name)
+            ):
+                problems.append(
+                    f"{where}: gauge needs a unit suffix or a whitelisted "
+                    "quantity suffix (see scripts/metrics_lint.py)"
+                )
+            if not help_ or not help_.strip():
+                problems.append(f"{where}: empty help string")
+            elif help_.strip() == name:
+                problems.append(f"{where}: help string just repeats the name")
+            if name in seen:
+                problems.append(
+                    f"{where}: duplicate of {seen[name]} (the /metrics "
+                    "endpoint concatenates registries — names must be unique)"
+                )
+            seen[name] = where
+    return problems
+
+
+def check_registrable(catalogs: dict[str, tuple]) -> list[str]:
+    """Register every family into a fresh Registry — catches anything the
+    name regex above is looser about than the registry itself."""
+    from devspace_tpu.obs.metrics import Registry
+
+    problems = []
+    reg = Registry()
+    for label, families in catalogs.items():
+        for fam in families:
+            name, kind, help_ = fam[0], fam[1], fam[2]
+            try:
+                if kind == "counter":
+                    reg.counter(name, help_)
+                elif kind == "gauge":
+                    reg.gauge(name, help_)
+                elif kind == "histogram":
+                    reg.histogram(name, help_)
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                problems.append(f"{label}:{name}: registry rejected it: {e}")
+    try:
+        reg.render()
+    except Exception as e:  # noqa: BLE001
+        problems.append(f"render() over all catalogs failed: {e}")
+    return problems
+
+
+def main() -> int:
+    catalogs = load_catalogs()
+    problems = lint(catalogs) + check_registrable(catalogs)
+    n = sum(len(f) for f in catalogs.values())
+    for p in problems:
+        print(f"ERROR {p}")
+    if problems:
+        print(f"{len(problems)} problem(s) across {n} metric families")
+        return 1
+    print(f"ok: {n} metric families across {len(catalogs)} catalogs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Metric consistency under injected failures (ISSUE 6 satellite).
+
+Chaos-marked (scripts/chaos_check.py runs these 3x and diffs outcomes):
+the telemetry layer must agree with the engine/sync failure ladders —
+every failed unit increments its failure counter EXACTLY once (the
+on_finish idempotency guard vs stop()'s fail-outstanding sweep, the
+quarantine early-return vs double _mark_worker_failed), and outcome
+counters partition the request set with no double count.
+"""
+
+import os
+
+import jax
+import pytest
+
+from devspace_tpu.inference import InferenceEngine
+from devspace_tpu.kube.fake import FakeCluster
+from devspace_tpu.models import transformer as tfm
+from devspace_tpu.resilience.chaos import ByteBudgetStream
+from devspace_tpu.sync.session import SyncOptions, SyncSession
+from devspace_tpu.utils.fsutil import write_file
+
+from tests.test_sync_pipeline import remote_path, wait_for
+
+CFG = tfm.TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.mark.chaos
+def test_metrics_consistent_across_mid_window_decode_failure(params):
+    """Inject a decode fault on the SECOND dispatch (chunk 1 in flight):
+    both slot-resident requests fail, a fresh one completes. Telemetry
+    must mirror the engine's ladder exactly — failed==2, completed==1,
+    outcomes partition all 3 requests, and stop()'s fail-outstanding
+    sweep must not re-count the already-finished ones."""
+    engine = InferenceEngine(
+        params, CFG, max_slots=2, max_len=64, dispatch_depth=2
+    )
+    calls = {"n": 0}
+
+    def wrap(fn):
+        def inner(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("injected decode fault")
+            return fn(*a, **k)
+
+        return inner
+
+    engine._decode_chunk = {
+        key: wrap(fn) for key, fn in engine._decode_chunk.items()
+    }
+    h1 = engine.submit([5, 1, 4], 24)
+    h2 = engine.submit([2, 9], 24)
+    engine.start()
+    try:
+        with pytest.raises(RuntimeError, match="decode failed"):
+            h1.result(timeout=300)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            h2.result(timeout=300)
+        got = engine.submit([7, 7, 7], 6).result(timeout=300)
+        tel = engine.telemetry
+        text = engine.metrics_text()
+    finally:
+        engine.stop()  # the sweep re-visits requests; counters must hold
+    assert len(got) == 6
+    st = engine.stats()
+    failed = tel.finished.labels(outcome="failed").value
+    completed = tel.finished.labels(outcome="completed").value
+    assert failed == st["requests_failed"] == 2
+    assert completed == st["requests_completed"] == 1
+    assert failed + completed == 3  # partition: no double count, no loss
+    assert "engine_requests_failed_total 2" in text
+    assert "engine_requests_completed_total 1" in text
+    # failed requests never reach the completion-latency histograms
+    assert tel.e2e.count == 1
+    assert tel.tpot.count == 1
+    outcomes = [t["outcome"] for t in tel.recent()]
+    assert sorted(outcomes) == ["completed", "failed", "failed"]
+
+
+@pytest.mark.chaos
+def test_metrics_consistent_across_worker_quarantine(tmp_path, monkeypatch):
+    """Kill sync worker 1 mid-broadcast (stream drop + failed revive):
+    exactly one quarantine increments ``workers_quarantined`` — and a
+    second _mark_worker_failed on the same worker (the races the
+    early-return guard exists for) must NOT double-count."""
+    cluster = FakeCluster(str(tmp_path / "cluster"))
+    local = tmp_path / "local"
+    local.mkdir()
+    workers = [
+        cluster.add_pod(f"w-{i}", labels={"app": "t"}, worker_id=i)
+        for i in range(3)
+    ]
+    opts = SyncOptions(
+        local_path=str(local),
+        container_path="/app",
+        upstream_quiet=0.15,
+        upstream_tick=0.05,
+        downstream_interval=0.15,
+    )
+    session = SyncSession(cluster, workers, opts)
+    write_file(str(local / "base.py"), "v0")
+    session.start()
+    try:
+        for w in workers:
+            wait_for(
+                lambda w=w: os.path.exists(remote_path(cluster, w, "base.py")),
+                msg="initial fan-out",
+            )
+        assert session.stats["workers_quarantined"] == 0
+        real_exec = cluster.exec_stream
+
+        def exec_stream(pod, *a, **kw):
+            if getattr(pod, "name", pod) == workers[1].name:
+                raise RuntimeError("pod gone")
+            return real_exec(pod, *a, **kw)
+
+        monkeypatch.setattr(cluster, "exec_stream", exec_stream)
+        session._shells[1].proc = ByteBudgetStream(session._shells[1].proc, 0)
+
+        write_file(str(local / "during.py"), "v1")
+        wait_for(lambda: 1 in session.worker_errors, msg="quarantine")
+        wait_for(
+            lambda: session.stats["workers_quarantined"] == 1,
+            msg="quarantine counter",
+        )
+        # second failure report for the SAME worker: early-return guard
+        # must keep the counter at 1
+        session._mark_worker_failed(1, RuntimeError("duplicate report"))
+        assert session.stats["workers_quarantined"] == 1
+        # the process-wide registry aggregates over live sessions
+        from devspace_tpu.obs.metrics import get_registry
+
+        rendered = get_registry().render()
+        for line in rendered.splitlines():
+            if line.startswith("sync_workers_quarantined_total "):
+                assert float(line.split()[-1]) >= 1.0
+                break
+        else:
+            raise AssertionError(f"no quarantine sample in:\n{rendered}")
+        assert session.error is None  # degraded, not wedged
+    finally:
+        session.stop()

@@ -1,0 +1,249 @@
+"""Metrics registry + Prometheus exposition (obs/metrics.py — ISSUE 6).
+
+Pins the primitives (counter monotonicity, histogram bucketing with the
+cumulative +Inf invariant), labeled families and callback metrics, the
+text-exposition renderer against a golden transcript (label escaping,
+``_bucket``/``_sum``/``_count``, ``# TYPE`` lines), the WindowedRate
+freshness gauge under a fake clock, the ``DEVSPACE_ENGINE_METRICS``
+escape hatch, and the metrics-name lint (scripts/metrics_lint.py) over
+every subsystem catalog.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from devspace_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    WindowedRate,
+    metrics_enabled,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- primitives -------------------------------------------------------------
+def test_counter_monotonic():
+    c = Counter()
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_up_and_down():
+    g = Gauge()
+    g.set(10)
+    g.dec(3)
+    g.inc()
+    assert g.value == 8.0
+
+
+def test_histogram_bucketing_and_snapshot():
+    h = Histogram(buckets=(0.25, 1.0, 4.0))
+    for v in (0.25, 0.3, 2.0, 100.0):  # boundary value lands IN its bucket
+        h.observe(v)
+    snap = h.snapshot()
+    # cumulative counts per le edge, +Inf last and == count
+    assert snap["buckets"] == [
+        (0.25, 1),
+        (1.0, 2),
+        (4.0, 3),
+        (float("inf"), 4),
+    ]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(102.55)
+    assert h.count == 4
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram(buckets=())
+    with pytest.raises(ValueError):
+        Histogram(buckets=(1.0, 1.0, 2.0))
+
+
+def test_default_latency_buckets_are_increasing():
+    assert list(DEFAULT_LATENCY_BUCKETS) == sorted(DEFAULT_LATENCY_BUCKETS)
+    assert len(set(DEFAULT_LATENCY_BUCKETS)) == len(DEFAULT_LATENCY_BUCKETS)
+
+
+# -- registry ---------------------------------------------------------------
+def test_registry_idempotent_and_kind_checked():
+    reg = Registry()
+    a = reg.counter("x_total", "x")
+    b = reg.counter("x_total", "x")
+    assert a is b  # same family, same child
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        reg.counter("Bad-Name", "nope")
+
+
+def test_labeled_family_schema_enforced():
+    reg = Registry()
+    fam = reg.counter("req_total", "requests", labels=("outcome",))
+    fam.labels(outcome="ok").inc(2)
+    fam.labels(outcome="err").inc()
+    assert fam.labels(outcome="ok").value == 2.0
+    with pytest.raises(ValueError):
+        fam.labels(wrong="key")
+    with pytest.raises(ValueError):
+        fam.labels()
+
+
+def test_callback_metrics_replace_and_conflict():
+    reg = Registry()
+    reg.register_callback("live_total", "counter", "live", lambda: 7)
+    assert "live_total 7" in reg.render()
+    # re-registering replaces (per-instance bridges re-bind on churn)
+    reg.register_callback("live_total", "counter", "live", lambda: 9)
+    assert "live_total 9" in reg.render()
+    # labeled callback: fn returns (labels, value) pairs
+    reg.register_callback(
+        "by_kind", "gauge", "by kind",
+        lambda: [({"kind": "a"}, 1), ({"kind": "b"}, 2)],
+        labels=("kind",),
+    )
+    out = reg.render()
+    assert 'by_kind{kind="a"} 1' in out and 'by_kind{kind="b"} 2' in out
+    # a callback may not shadow a direct metric
+    reg.counter("direct_total", "direct")
+    with pytest.raises(ValueError):
+        reg.register_callback("direct_total", "counter", "x", lambda: 0)
+    # histograms can't be callbacks
+    with pytest.raises(ValueError):
+        reg.register_callback("h_seconds", "histogram", "x", lambda: 0)
+
+
+def test_unregister_removes_family():
+    reg = Registry()
+    reg.counter("gone_total", "bye")
+    reg.unregister("gone_total")
+    assert reg.names() == []
+
+
+# -- golden exposition transcript -------------------------------------------
+def test_render_golden():
+    """Exact text-exposition bytes: HELP/TYPE lines, label-value escaping
+    of backslash/quote/newline, histogram _bucket/_sum/_count with +Inf,
+    integer values bare, families sorted by name."""
+    reg = Registry()
+    c = reg.counter("jobs_done_total", "Jobs done")
+    c.inc()
+    c.inc(2)
+    g = reg.gauge("queue_depth", "Depth", labels=("queue",))
+    g.labels(queue='a"b\\c\nd').set(3)
+    h = reg.histogram("op_seconds", "Op latency", buckets=(0.25, 1.0))
+    for v in (0.25, 0.5, 4.0):  # dyadic values: float sums are exact
+        h.observe(v)
+    expected = "\n".join(
+        [
+            "# HELP jobs_done_total Jobs done",
+            "# TYPE jobs_done_total counter",
+            "jobs_done_total 3",
+            "# HELP op_seconds Op latency",
+            "# TYPE op_seconds histogram",
+            'op_seconds_bucket{le="0.25"} 1',
+            'op_seconds_bucket{le="1"} 2',
+            'op_seconds_bucket{le="+Inf"} 3',
+            "op_seconds_sum 4.75",
+            "op_seconds_count 3",
+            "# HELP queue_depth Depth",
+            "# TYPE queue_depth gauge",
+            'queue_depth{queue="a\\"b\\\\c\\nd"} 3',
+            "",
+        ]
+    )
+    assert reg.render() == expected
+
+
+def test_render_escapes_help_newlines():
+    reg = Registry()
+    reg.counter("multi_total", "line one\nline two")
+    assert "# HELP multi_total line one\\nline two" in reg.render()
+
+
+def test_render_empty_registry():
+    assert Registry().render() == ""
+
+
+def test_render_concurrent_with_observes():
+    """Scrapes render while the scheduler thread observes — no tearing,
+    no exceptions, count never exceeds what was observed."""
+    reg = Registry()
+    h = reg.histogram("t_seconds", "t")
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            h.observe(0.01)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(50):
+            out = reg.render()
+            assert "# TYPE t_seconds histogram" in out
+    finally:
+        stop.set()
+        t.join()
+    snap = h.snapshot()
+    assert snap["buckets"][-1][1] == snap["count"]
+
+
+# -- windowed rate ----------------------------------------------------------
+def test_windowed_rate_decays_where_lifetime_average_lies():
+    clock = {"t": 0.0}
+    r = WindowedRate(10.0, clock=lambda: clock["t"])
+    for s in range(10):
+        clock["t"] = float(s)
+        r.add(5)
+    clock["t"] = 9.0
+    assert r.rate() == pytest.approx(5.0)  # 50 events over the last 10s
+    clock["t"] = 25.0  # 16s of silence: every bucket is stale
+    assert r.rate() == 0.0
+    r.add(10)
+    assert r.rate() == pytest.approx(1.0)  # 10 events / 10s window
+
+
+def test_windowed_rate_bucket_reuse_after_wrap():
+    clock = {"t": 0.0}
+    r = WindowedRate(3.0, clock=lambda: clock["t"])
+    r.add(100)  # t=0
+    clock["t"] = 4.0  # wraps onto the t=0 bucket (4 % 4 == 0)
+    r.add(1)
+    assert r.rate() == pytest.approx(1 / 3)  # stale 100 must NOT leak in
+
+
+# -- escape hatch -----------------------------------------------------------
+def test_metrics_enabled_resolution(monkeypatch):
+    monkeypatch.delenv("DEVSPACE_ENGINE_METRICS", raising=False)
+    assert metrics_enabled() is True
+    assert metrics_enabled(False) is False
+    for off in ("off", "0", "false", "NO"):
+        monkeypatch.setenv("DEVSPACE_ENGINE_METRICS", off)
+        assert metrics_enabled() is False
+        assert metrics_enabled(True) is True  # explicit arg beats env
+    monkeypatch.setenv("DEVSPACE_ENGINE_METRICS", "on")
+    assert metrics_enabled() is True
+
+
+# -- the naming lint over every subsystem catalog ---------------------------
+def test_metrics_lint_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "metrics_lint.py")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok:" in proc.stdout

@@ -1,0 +1,256 @@
+"""Per-request serving traces (obs/request_trace.py — ISSUE 6).
+
+TTFT/TPOT/queue-wait/prefill/e2e are asserted against HAND-COMPUTED
+values under an injected clock (the hooks never read the wall clock
+directly), plus: terminal idempotency (the failure ladder and stop()
+racing to finish the same request must not double-count), the bounded
+recent-request ring, JSONL + Chrome-trace export through the shared span
+writer, the utils/trace ring's keep-newest rotation with its
+``spans_dropped`` counter, and an end-to-end tiny-engine run pinning
+that every histogram sees exactly one observation per request.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from devspace_tpu.obs.request_trace import (
+    SERVING_METRIC_FAMILIES,
+    ServingTelemetry,
+)
+from devspace_tpu.utils import trace as trace_mod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def req(prompt_len=4, n=8):
+    return SimpleNamespace(prompt_ids=list(range(prompt_len)), max_new_tokens=n)
+
+
+# -- hand-computed latency derivations --------------------------------------
+def test_lifecycle_latencies_exact():
+    """enqueue t=0, admit t=1, prefill done t=2, tokens at t=3/4/5,
+    finish t=5: queue_wait=1, prefill=1, ttft=3, tpot=(5-3)/(3-1)=1,
+    e2e=5 — every histogram sees exactly these values."""
+    clock = FakeClock()
+    tel = ServingTelemetry(clock=clock)
+    r = req()
+    tel.on_submit(r)
+    clock.t = 1.0
+    tel.on_admit(r)
+    clock.t = 2.0
+    tel.on_prefill_done(r)
+    for t in (3.0, 4.0, 5.0):
+        clock.t = t
+        tel.on_emit(r)
+    tel.on_finish(r, "completed")
+
+    assert (tel.queue_wait.sum, tel.queue_wait.count) == (1.0, 1)
+    assert (tel.prefill.sum, tel.prefill.count) == (1.0, 1)
+    assert (tel.ttft.sum, tel.ttft.count) == (3.0, 1)
+    assert (tel.tpot.sum, tel.tpot.count) == (1.0, 1)
+    assert (tel.e2e.sum, tel.e2e.count) == (5.0, 1)
+    assert tel.finished.labels(outcome="completed").value == 1.0
+
+    d = r._obs_trace.to_dict()
+    assert d["outcome"] == "completed"
+    assert d["queue_wait_s"] == 1.0
+    assert d["prefill_s"] == 1.0
+    assert d["ttft_s"] == 3.0
+    assert d["tpot_s"] == 1.0
+    assert d["e2e_s"] == 5.0
+    assert d["tokens_generated"] == 3
+    assert [name for name, _ in d["events"]] == [
+        "enqueue", "admit", "prefill_done", "first_token", "completed",
+    ]
+
+
+def test_single_token_request_has_no_tpot():
+    clock = FakeClock()
+    tel = ServingTelemetry(clock=clock)
+    r = req(n=1)
+    tel.on_submit(r)
+    tel.on_admit(r)
+    clock.t = 2.0
+    tel.on_emit(r)
+    tel.on_finish(r, "completed")
+    assert tel.ttft.count == 1
+    assert tel.tpot.count == 0  # inter-token time needs >= 2 tokens
+    assert r._obs_trace.to_dict()["tpot_s"] is None
+
+
+def test_readmission_keeps_first_admit_and_preempt_count():
+    """queue_wait is enqueue -> FIRST admission; a preempt + re-admit
+    must not re-observe it (or shrink it)."""
+    clock = FakeClock()
+    tel = ServingTelemetry(clock=clock)
+    r = req()
+    tel.on_submit(r)
+    clock.t = 1.0
+    tel.on_admit(r)
+    clock.t = 2.0
+    tel.on_preempt(r)
+    clock.t = 7.0
+    tel.on_admit(r)  # resume
+    assert tel.queue_wait.count == 1
+    assert tel.queue_wait.sum == 1.0
+    assert r._obs_trace.preemptions == 1
+
+
+def test_finish_is_idempotent():
+    """stop()'s fail-outstanding sweep and the scheduler's own failure
+    path can both reach a request; the first terminal outcome wins."""
+    tel = ServingTelemetry(clock=FakeClock())
+    r = req()
+    tel.on_submit(r)
+    tel.on_finish(r, "failed")
+    tel.on_finish(r, "completed")
+    tel.on_finish(r, "failed")
+    assert tel.finished.labels(outcome="failed").value == 1.0
+    assert tel.finished.labels(outcome="completed").value == 0.0
+    assert r._obs_trace.outcome == "failed"
+    assert tel.e2e.count == 0  # failed requests don't pollute e2e/tpot
+
+
+def test_untracked_request_is_ignored():
+    """Hooks tolerate requests submitted before telemetry attached (or
+    with metrics off): no _obs_trace -> every hook is a no-op."""
+    tel = ServingTelemetry(clock=FakeClock())
+    bare = SimpleNamespace(prompt_ids=[1], max_new_tokens=2)
+    tel.on_admit(bare)
+    tel.on_emit(bare)
+    tel.on_finish(bare, "completed")
+    assert tel.finished.labels(outcome="completed").value == 0.0
+
+
+def test_recent_ring_is_bounded():
+    tel = ServingTelemetry(clock=FakeClock(), ring=4)
+    for _ in range(10):
+        tel.on_submit(req())
+    got = tel.recent(limit=100)
+    assert len(got) == 4
+    assert [g["id"] for g in got] == [7, 8, 9, 10]  # newest kept
+
+
+def test_export_jsonl_and_chrome(tmp_path):
+    clock = FakeClock()
+    tel = ServingTelemetry(clock=clock)
+    for i in range(3):
+        r = req()
+        tel.on_submit(r)
+        clock.t += 1.0
+        tel.on_admit(r)
+        clock.t += 1.0
+        tel.on_emit(r)
+        tel.on_finish(r, "completed")
+    jl = tmp_path / "reqs.jsonl"
+    assert tel.export_jsonl(str(jl)) == 3
+    rows = [json.loads(line) for line in jl.read_text().splitlines()]
+    assert [r["id"] for r in rows] == [1, 2, 3]
+    assert all(r["outcome"] == "completed" for r in rows)
+
+    ct = tmp_path / "reqs.trace.json"
+    n = tel.export_chrome(str(ct))
+    events = json.loads(ct.read_text())["traceEvents"]
+    assert len(events) == n and n > 0
+    names = {e["name"] for e in events}
+    assert "queue_wait" in names and "request-1" in names
+    assert all(e["ph"] == "X" for e in events)
+
+
+def test_catalog_matches_registered_families():
+    tel = ServingTelemetry(clock=FakeClock())
+    assert tel.registry.names() == sorted(n for n, _, _ in SERVING_METRIC_FAMILIES)
+
+
+# -- utils/trace ring rotation + dropped counter ----------------------------
+def test_span_ring_rotates_keeping_newest(monkeypatch):
+    monkeypatch.setattr(trace_mod, "_MAX_SPANS", 5)
+    monkeypatch.setattr(trace_mod, "_spans", [])
+    monkeypatch.setattr(trace_mod, "_spans_dropped", 0)
+    for i in range(8):
+        with trace_mod.span(f"s{i}"):
+            pass
+    assert trace_mod.dropped() == 3
+    assert [s["name"] for s in trace_mod.recent()] == [
+        "s3", "s4", "s5", "s6", "s7",
+    ]
+    # the default registry's callback reads the same counter
+    from devspace_tpu.obs.metrics import get_registry
+
+    assert "trace_spans_dropped_total 3" in get_registry().render()
+
+
+# -- end-to-end through the engine ------------------------------------------
+@pytest.fixture(scope="module")
+def engine_params():
+    import jax
+
+    from devspace_tpu.models import transformer as tfm
+
+    return tfm.init_params(tfm.TINY, jax.random.PRNGKey(0))
+
+
+def test_engine_histograms_count_one_observation_per_request(engine_params):
+    from devspace_tpu.inference import InferenceEngine
+    from devspace_tpu.models import transformer as tfm
+
+    engine = InferenceEngine(
+        engine_params, tfm.TINY, max_slots=2, max_len=64, chunk_max=16
+    ).start()
+    try:
+        handles = [
+            engine.submit([1 + i, 2, 3], 4 + i) for i in range(3)
+        ]
+        for h in handles:
+            h.result(timeout=600)
+        st = engine.stats()
+        text = engine.metrics_text()
+        tel = engine.telemetry
+        assert tel is not None
+        for hist in (tel.ttft, tel.queue_wait, tel.prefill, tel.e2e, tel.tpot):
+            assert hist.count == 3
+        assert tel.finished.labels(outcome="completed").value == 3.0
+    finally:
+        engine.stop()
+    assert "tokens_per_sec_10s" in st
+    assert st["requests_completed"] == 3
+    # exposition text carries nonzero serving histograms + engine counters
+    assert 'ttft_seconds_bucket{le="+Inf"} 3' in text
+    assert "ttft_seconds_count 3" in text
+    assert "tpot_seconds_count 3" in text
+    assert "queue_wait_seconds_count 3" in text
+    assert "engine_requests_completed_total 3" in text
+    assert 'requests_finished_total{outcome="completed"} 3' in text
+    traces = tel.recent()
+    assert len(traces) == 3
+    assert all(t["outcome"] == "completed" for t in traces)
+    assert [t["tokens_generated"] for t in traces] == [4, 5, 6]
+
+
+def test_engine_metrics_escape_hatch(engine_params):
+    """metrics=False: no telemetry object, no per-token hook work, empty
+    exposition — and stats() is byte-compatible either way."""
+    from devspace_tpu.inference import InferenceEngine
+    from devspace_tpu.models import transformer as tfm
+
+    engine = InferenceEngine(
+        engine_params, tfm.TINY, max_slots=1, max_len=64, metrics=False
+    ).start()
+    try:
+        engine.submit([1, 2], 3).result(timeout=600)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert engine.telemetry is None
+    assert engine.metrics_text() == ""
+    assert engine.metrics_registry is None
+    assert st["requests_completed"] == 1
+    assert "tokens_per_sec_10s" in st  # the windowed rate stays on
