@@ -408,6 +408,81 @@ def bench_serving() -> dict:
         moff_s, _, _ = wave(None, "metrics-off")
     finally:
         os.environ.pop("DEVSPACE_ENGINE_METRICS", None)
+
+    # KV-tier pressure A/B (ISSUE 7): a multi-tenant prefix-revisit
+    # workload on a pool sized to HALF the unique working set (2x KV
+    # oversubscription), tier off vs host. Two tenant groups alternate
+    # waves, so every revisit finds its prefix chain evicted (revisit
+    # distance > pool) — tier-off recomputes the whole prefix through
+    # chunked prefill, tier-on restores the spilled chain from host
+    # RAM. Closed-loop, all requests up front, FIFO: deterministic.
+    hb("serving: kv-tier pressure A/B")
+    if on_tpu:
+        pcfg = cfg  # the dim-1024 serving config
+        p_tenants, p_prefix, p_tail, p_new, p_bs = 4, 512, 32, 16, 32
+        p_chunk = 32
+    else:
+        # TINY's prefill chunks are too cheap for restores to beat on
+        # CPU — use a mid-size config where recompute actually costs
+        pcfg = tfm.TransformerConfig(
+            vocab_size=1024, dim=256, n_layers=4, n_heads=4,
+            n_kv_heads=4, ffn_dim=512, max_seq_len=512,
+        )
+        p_tenants, p_prefix, p_tail, p_new, p_bs = 4, 320, 16, 8, 16
+        p_chunk = 16
+    p_params = tfm.init_params(pcfg, jax.random.PRNGKey(1))
+    prng = np.random.default_rng(0)
+    tenant_prefixes = [
+        list(prng.integers(1, 1000, size=p_prefix))
+        for _ in range(2 * p_tenants)
+    ]
+
+    def _tenant_req(prefix):
+        return dict(
+            prompt_ids=prefix + list(prng.integers(1, 1000, size=p_tail)),
+            max_new_tokens=p_new,
+        )
+
+    group_a = tenant_prefixes[:p_tenants]
+    group_b = tenant_prefixes[p_tenants:]
+    p_reqs = []
+    for group in (group_a, group_b, group_a, group_b, group_a):
+        p_reqs += [_tenant_req(t) for t in group]
+    per_seq = -(-(p_prefix + p_tail + p_new) // p_bs)
+    pre_blocks = p_prefix // p_bs
+    unique_blocks = 2 * p_tenants * pre_blocks + len(p_reqs) * (
+        per_seq - pre_blocks
+    )
+    p_pool = 1 + unique_blocks // 2
+
+    def pressure_arm(kv_tier):
+        hb(f"serving: pressure arm kv_tier={kv_tier}")
+        engine = InferenceEngine(
+            p_params, pcfg, max_slots=2,
+            max_len=p_prefix + p_tail + p_new + p_bs,
+            block_size=p_bs, n_blocks=p_pool, prefill_chunk=p_chunk,
+            chunk_max=4, kv_tier=kv_tier,
+        ).start()
+        try:
+            warm = np.random.default_rng(9)
+            for h in [
+                engine.submit(list(warm.integers(1, 1000, size=32)), 4)
+                for _ in range(2)
+            ]:
+                h.result(timeout=600)
+            t0 = time.time()
+            for h in [engine.submit(**r) for r in p_reqs]:
+                h.result(timeout=600)
+            elapsed = time.time() - t0
+            st = engine.stats()
+        finally:
+            engine.stop()
+        return elapsed, st
+
+    poff_s, poff_st = pressure_arm("off")
+    pon_s, pon_st = pressure_arm("host")
+    p_total = len(p_reqs) * p_new
+
     total = n_req * new_tokens
     res = {
         "serving_tok_per_sec": round(total / ov_s, 1),
@@ -425,6 +500,20 @@ def bench_serving() -> dict:
         "requests": n_req,
         "new_tokens": new_tokens,
         "platform": platform,
+        "kv_pressure_tok_per_sec": round(p_total / pon_s, 1),
+        "kv_pressure_off_tok_per_sec": round(p_total / poff_s, 1),
+        "kv_pressure_speedup": round(poff_s / pon_s, 2),
+        "kv_restore_hit_rate": pon_st["kv_restore_hit_rate"],
+        "kv_restore_hits": pon_st["kv_restore_hits"],
+        "kv_restore_fallbacks": pon_st["kv_restore_fallbacks"],
+        "kv_spill_blocks": pon_st["kv_spill_blocks"],
+        "kv_recompute_tokens_saved": pon_st["recompute_tokens_saved"],
+        "kv_pressure_preemptions": pon_st["requests_preempted"],
+        "kv_pressure_preemptions_off": poff_st["requests_preempted"],
+        "kv_pressure_oversubscription": round(
+            unique_blocks / (p_pool - 1), 2
+        ),
+        "kv_pressure_requests": len(p_reqs),
     }
     log(
         f"[bench] serving: {res['serving_tok_per_sec']} tok/s overlapped "
@@ -445,6 +534,17 @@ def bench_serving() -> dict:
             if res["serving_metrics_overhead_pct"] > 2.0 and on_tpu
             else ""
         )
+    )
+    log(
+        f"[bench] kv-tier pressure "
+        f"({res['kv_pressure_oversubscription']}x oversubscribed): "
+        f"{res['kv_pressure_tok_per_sec']} tok/s tier-on vs "
+        f"{res['kv_pressure_off_tok_per_sec']} tok/s tier-off -> "
+        f"{res['kv_pressure_speedup']}x; restore hit rate "
+        f"{res['kv_restore_hit_rate']}, "
+        f"{res['kv_recompute_tokens_saved']} recompute tokens saved, "
+        f"preemptions on/off {res['kv_pressure_preemptions']}/"
+        f"{res['kv_pressure_preemptions_off']}"
     )
     return res
 

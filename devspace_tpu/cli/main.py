@@ -323,6 +323,14 @@ def _status_serving(args) -> int:
         ("tokens_per_sec", "tok/s (lifetime)"),
         ("tokens_per_sec_10s", "tok/s (10s)"),
         ("free_blocks", "free kv blocks"),
+        # host KV tier (inference/kv_tier.py; ISSUE 7) — "off" with the
+        # tier disabled, restore/spill traffic when chains cycle
+        ("kv_tier", "kv tier"),
+        ("kv_tier_resident_bytes", "kv tier resident bytes"),
+        ("kv_spill_blocks", "kv blocks spilled"),
+        ("kv_restore_hits", "kv restore hits"),
+        ("kv_restore_fallbacks", "kv restore fallbacks"),
+        ("recompute_tokens_saved", "recompute tokens saved"),
         ("uptime_s", "uptime (s)"),
     ]
     log.print_table(

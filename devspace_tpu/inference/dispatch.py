@@ -43,6 +43,14 @@ that fact:
   drained — the in-flight writes land in the slot's own blocks (or the
   scratch block once a later dispatch parks it), never in a peer's.
 
+- **Tiered-restore overlap**: the engine's host-KV-tier restores
+  (``_restore_spilled``) dispatch their scatter jits against the same
+  donated pool chain — under async dispatch they queue behind the
+  in-flight window's chunks and compute while the host drains tokens
+  and schedules, so a restore costs wall-clock only what outruns the
+  window. ``note_restores`` counts how many restores actually found
+  chunks in flight (``kv_restores_overlapped`` in stats()).
+
 - **Failure ladder**: a decode failure surfaces at readback (async
   dispatch defers device errors). ``abandon()`` drops the whole window
   — every in-flight chunk's requests are failed by the caller
@@ -137,6 +145,11 @@ class DecodeDispatcher:
         self.occupancy_sum = 0  # window depth summed at each dispatch
         self.readback_wait_s = 0.0  # host time blocked in device_get
         self.loop_busy_s = 0.0  # scheduler-iteration time (engine adds)
+        # host-tier restores (engine._restore_spilled): total scatter
+        # dispatches and how many went out while decode chunks were in
+        # flight — those restores' device work hides behind the window
+        self.kv_restores = 0
+        self.kv_restores_overlapped = 0
 
     # -- carry -------------------------------------------------------------
     def _fresh_carry(self) -> dict:
@@ -203,6 +216,17 @@ class DecodeDispatcher:
     @property
     def full(self) -> bool:
         return len(self.window) >= self.depth
+
+    def note_restores(self, n: int, overlapped: bool) -> None:
+        """Engine hook: ``n`` spilled KV blocks were just restored via
+        async scatter dispatches. ``overlapped=True`` when the window
+        held in-flight decode chunks at restore time — the scatters
+        then chain behind them device-side (the donated pool handle is
+        the newest chunk's output) while peers' drain work proceeds,
+        which is the overlap the tiered-restore design pays for."""
+        self.kv_restores += n
+        if overlapped:
+            self.kv_restores_overlapped += n
 
     def slot_busy(self, i: int) -> bool:
         """True while in-flight chunks still reference slot i — a
@@ -320,6 +344,7 @@ class DecodeDispatcher:
                 max(0.0, self.loop_busy_s - self.readback_wait_s), 4
             ),
             "carry_updates": self.carry_updates,
+            "kv_restores_overlapped": self.kv_restores_overlapped,
         }
 
 

@@ -49,6 +49,7 @@ optional EOS early stop.
 from __future__ import annotations
 
 import math
+import os
 import queue
 import threading
 import time
@@ -63,7 +64,20 @@ from ..models import transformer as tfm
 from ..obs.metrics import Registry, WindowedRate, metrics_enabled
 from ..obs.request_trace import ServingTelemetry
 from .dispatch import DecodeDispatcher, resolve_dispatch_depth
+from .kv_tier import (
+    HostKVTier,
+    pack_kv_payload,
+    resolve_kv_tier,
+    unpack_kv_payload,
+)
 from .prefix_cache import RadixPrefixCache
+from .quantization import KV_SCALE_EPS
+
+# Blocks per restore-scatter dispatch: one fixed shape (short chains pad
+# into scratch block 0) so a chain of any length costs ceil(n/16)
+# dispatches instead of n — per-block dispatch overhead would eat the
+# recompute savings the tier exists to deliver.
+_RESTORE_BATCH = 16
 
 # Metric families the engine registers over its serving counters
 # (pull-style: each callback reads the same ints stats() reports — ONE
@@ -83,6 +97,32 @@ ENGINE_METRIC_FAMILIES = (
     ("engine_prefix_hit_blocks_total", "counter",
      "Prompt blocks served from the radix prefix cache at admission",
      "prefix_hit_blocks"),
+    ("engine_prefix_hit_tokens_total", "counter",
+     "Prompt tokens whose prefill was skipped at admission (resident "
+     "radix hits plus host-tier restores)", "prefix_hit_tokens"),
+    ("engine_recompute_tokens_saved_total", "counter",
+     "Prompt tokens restored from the host KV tier instead of "
+     "recompute-prefilled (the tier-attributable subset of prefix hits)",
+     "recompute_tokens_saved"),
+    ("engine_kv_spill_bytes_total", "counter",
+     "Bytes of evicted KV copied device->host into the tier (packed, "
+     "int8-quantized)", "kv_spill_bytes"),
+    ("engine_kv_spill_blocks_total", "counter",
+     "Evicted KV blocks spilled to the host tier", "kv_spill_blocks"),
+    ("engine_kv_restore_hits_total", "counter",
+     "Spilled blocks restored host->device on a radix match",
+     "kv_restore_hits"),
+    ("engine_kv_restore_fallbacks_total", "counter",
+     "Restore attempts that fell back to recompute-prefill (tier miss, "
+     "corrupt payload, or restore error)", "kv_restore_fallbacks"),
+    ("engine_kv_tier_resident_bytes", "gauge",
+     "Host RAM currently held by the KV tier", "kv_tier_resident_bytes"),
+    # histogram families carry no stats_key: _register_metric_families
+    # creates a real instrument (observed per restore event) instead of
+    # a pull callback
+    ("engine_kv_restore_seconds", "histogram",
+     "Latency of one spilled-chain restore (tier reads + scatter "
+     "dispatches; async device work excluded)", None),
     ("engine_decode_dispatches_total", "counter",
      "Decode chunks dispatched by the overlapped serving loop",
      "decode_dispatches"),
@@ -276,6 +316,9 @@ class InferenceEngine:
         dispatch_depth: Optional[int] = None,
         metrics: Optional[bool] = None,
         metrics_registry: Optional[Registry] = None,
+        kv_tier: Optional[str] = None,
+        kv_tier_bytes: int = 256 << 20,
+        kv_tier_dir: Optional[str] = None,
     ):
         """``mesh`` turns on tensor-parallel serving: params are placed per
         ``models.transformer.param_partition_spec`` and the KV pool is
@@ -335,6 +378,22 @@ class InferenceEngine:
 
         ``prewarm=True`` compiles every reachable program in ``start()``
         before the scheduler thread runs (see :meth:`prewarm`).
+
+        ``kv_tier`` adds a host tier below the HBM block pool
+        (inference/kv_tier.py): ``"host"`` spills evicted prefix chains
+        to host RAM (``kv_tier_bytes`` LRU budget, int8-quantized with
+        per-block scales), ``"host+disk"`` overflows RAM evictions to
+        digest-named files under ``kv_tier_dir``. A radix match landing
+        on a spilled chain restores it host->device (async scatter,
+        overlapped with in-flight decode chunks) instead of
+        recompute-prefilling; preempted requests' chains spill too, so
+        resume restores. Default off (env knob ``DEVSPACE_KV_TIER``);
+        behavior with the tier off — and in an unpressured pool with it
+        on — is byte-identical to before. On a FLOAT pool restored
+        blocks carry ~0.5% int8 quantization noise (greedy near-ties
+        can flip, the same caveat as ``kv_dtype="int8"``); on an int8
+        pool the spill copies the quantized representation verbatim and
+        restores are exact.
 
         ``dispatch_depth`` sizes the overlapped serving loop's in-flight
         decode window (inference/dispatch.py): depth 2 (the default)
@@ -513,9 +572,40 @@ class InferenceEngine:
         # O(evicted chain), never O(whole cache).
         self.prefix_cache_enabled = bool(prefix_cache)
         self._prewarm_on_start = bool(prewarm)
-        self._prefix_cache = RadixPrefixCache()
+        # host KV tier (inference/kv_tier.py): evicted chains spill
+        # device->host instead of vanishing; radix matches on spilled
+        # chains restore instead of recomputing. None when off — every
+        # tier code path below is gated on it, so the untiered engine
+        # is byte-identical to before.
+        self.kv_tier_mode = resolve_kv_tier(kv_tier)
+        self._kv_tier: Optional[HostKVTier] = None
+        if self.kv_tier_mode != "off" and self.prefix_cache_enabled:
+            disk_dir = None
+            if self.kv_tier_mode == "host+disk":
+                import tempfile
+
+                disk_dir = kv_tier_dir or os.path.join(
+                    tempfile.gettempdir(), f"devspace-kv-tier-{os.getpid()}"
+                )
+            self._kv_tier = HostKVTier(
+                max_bytes=kv_tier_bytes, disk_dir=disk_dir
+            )
+            self._kv_tier.on_evict = self._on_tier_evict
+        elif self.kv_tier_mode != "off":
+            # a tier without the prefix cache has nothing to spill
+            self.kv_tier_mode = "off"
+        self._prefix_cache = RadixPrefixCache(
+            track_digests=self._kv_tier is not None
+        )
         self._block_refs: dict[int, int] = {}  # blk -> table references
         self.prefix_hit_blocks = 0
+        self.prefix_hit_tokens = 0
+        self.recompute_tokens_saved = 0
+        self.kv_spill_blocks = 0
+        self.kv_spill_bytes = 0
+        self.kv_restore_hits = 0
+        self.kv_restore_fallbacks = 0
+        self._kv_restore_hist = None  # set by _register_metric_families
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
         self._resume: list[Request] = []  # preempted, re-admit first
@@ -690,6 +780,82 @@ class InferenceEngine:
             ),
             donate_argnums=1,
         )
+
+        if self._kv_tier is not None:
+            int8_pool = self._kv_jnp_dtype is jnp.int8
+
+            def restore_chain(pool, idx, kq, ks, vq, vs):
+                # Up to _RESTORE_BATCH spilled blocks scattered back into
+                # freshly popped pool slots in ONE dispatch (per-block
+                # dispatches drown the win in launch overhead). Fixed
+                # shapes idx [R], kq/vq [L, R, Hkv, bs, D], ks/vs
+                # [L, R, Hkv, bs] -> exactly one compile; short chains
+                # pad their index lanes with scratch block 0 (clobbering
+                # it is fine — every prewarm dispatch already does). The
+                # pool is donated, so under async dispatch the scatter
+                # chains AFTER every in-flight decode chunk (the handle
+                # it consumes is the newest chunk's output) and OVERLAPS
+                # their host-side drain. An int8 pool takes the
+                # quantized payload verbatim (restores are exact); a
+                # float pool dequantizes here, device-side, halving H2D
+                # bytes vs shipping floats.
+                if int8_pool:
+                    return dict(
+                        pool,
+                        k=pool["k"].at[:, idx].set(kq),
+                        v=pool["v"].at[:, idx].set(vq),
+                        k_scale=pool["k_scale"].at[:, idx].set(ks),
+                        v_scale=pool["v_scale"].at[:, idx].set(vs),
+                    )
+                k = (kq.astype(jnp.float32) * ks[..., None]).astype(
+                    pool["k"].dtype
+                )
+                v = (vq.astype(jnp.float32) * vs[..., None]).astype(
+                    pool["v"].dtype
+                )
+                return dict(
+                    pool,
+                    k=pool["k"].at[:, idx].set(k),
+                    v=pool["v"].at[:, idx].set(v),
+                )
+
+            self._restore_chain_jit = jax.jit(
+                restore_chain, donate_argnums=0
+            )
+
+            def gather_chain(pool, idx):
+                # Spill-side twin: up to _RESTORE_BATCH evicted blocks
+                # gathered in ONE dispatch, quantized DEVICE-side for
+                # float pools (same symmetric amax/127 convention as
+                # quantization.quantize_kv_block) so the host copy
+                # moves int8 + scales, not floats. idx is TRACED — a
+                # python-int pool index would bake the block id into
+                # the compiled gather and recompile per block. Padding
+                # lanes read scratch block 0 and are discarded.
+                k = pool["k"][:, idx]  # [L, R, Hkv, bs, D]
+                v = pool["v"][:, idx]
+                if int8_pool:
+                    return (
+                        k, pool["k_scale"][:, idx],
+                        v, pool["v_scale"][:, idx],
+                    )
+                k32 = k.astype(jnp.float32)
+                v32 = v.astype(jnp.float32)
+                ks = jnp.maximum(
+                    jnp.max(jnp.abs(k32), axis=-1), KV_SCALE_EPS
+                ) / 127.0
+                vs = jnp.maximum(
+                    jnp.max(jnp.abs(v32), axis=-1), KV_SCALE_EPS
+                ) / 127.0
+                kq = jnp.clip(
+                    jnp.round(k32 / ks[..., None]), -127, 127
+                ).astype(jnp.int8)
+                vq = jnp.clip(
+                    jnp.round(v32 / vs[..., None]), -127, 127
+                ).astype(jnp.int8)
+                return kq, ks, vq, vs
+
+            self._gather_chain_jit = jax.jit(gather_chain)
 
         if draft_params is not None:
             from .speculative import _draft_propose_sampled, spec_accept_commit
@@ -1000,6 +1166,25 @@ class InferenceEngine:
             zero_tables,
         )
         timings["carry_update"] = round(time.monotonic() - t0, 3)
+        if self._kv_tier is not None:
+            # the host-tier restore scatter has ONE shape; scatter zeros
+            # into scratch block 0 (pool contents untouched, like every
+            # prewarm dispatch) so a first restore mid-serving never
+            # pays a compile
+            L, Hkv, D = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
+            R = _RESTORE_BATCH
+            zq = jnp.zeros((L, R, Hkv, self.block_size, D), jnp.int8)
+            zs = jnp.zeros((L, R, Hkv, self.block_size), jnp.float32)
+            t0 = time.monotonic()
+            self.pool = self._restore_chain_jit(
+                self.pool, jnp.zeros((R,), jnp.int32), zq, zs, zq, zs
+            )
+            timings["kv_restore_scatter"] = round(time.monotonic() - t0, 3)
+            t0 = time.monotonic()
+            jax.block_until_ready(
+                self._gather_chain_jit(self.pool, jnp.zeros((R,), jnp.int32))
+            )
+            timings["kv_spill_gather"] = round(time.monotonic() - t0, 3)
         if self.draft_params is not None:
             # _draft_prefill buckets: powers of two, clamped at max_len
             # (itself a bucket when not a power of two)
@@ -1057,6 +1242,27 @@ class InferenceEngine:
             "total_blocks": self.n_blocks - 1,
             "prefix_cached_blocks": len(self._prefix_cache),
             "prefix_hit_blocks": self.prefix_hit_blocks,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "recompute_tokens_saved": self.recompute_tokens_saved,
+            # host KV tier (inference/kv_tier.py) — all-zero with the
+            # tier off, so dashboards can key on one schema
+            "kv_tier": self.kv_tier_mode,
+            "kv_spill_blocks": self.kv_spill_blocks,
+            "kv_spill_bytes": self.kv_spill_bytes,
+            "kv_restore_hits": self.kv_restore_hits,
+            "kv_restore_fallbacks": self.kv_restore_fallbacks,
+            "kv_restore_hit_rate": round(
+                self.kv_restore_hits
+                / (self.kv_restore_hits + self.kv_restore_fallbacks),
+                4,
+            )
+            if (self.kv_restore_hits + self.kv_restore_fallbacks)
+            else 0.0,
+            "kv_tier_resident_bytes": (
+                self._kv_tier.resident_bytes if self._kv_tier else 0
+            ),
+            "kv_tier_entries": len(self._kv_tier) if self._kv_tier else 0,
+            "kv_tier_spilled_nodes": self._prefix_cache.spilled_count(),
             "queued": self.pending.qsize() + len(self._resume),
             "uptime_s": round(uptime, 1),
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
@@ -1114,6 +1320,12 @@ class InferenceEngine:
             return fn
 
         for name, kind, help_, key in ENGINE_METRIC_FAMILIES:
+            if kind == "histogram":
+                # histograms are real instruments observed per event,
+                # not pull callbacks over stats() ints
+                if name == "engine_kv_restore_seconds":
+                    self._kv_restore_hist = reg.histogram(name, help_)
+                continue
             reg.register_callback(name, kind, help_, reader(key))
 
     def stop(self) -> None:
@@ -1147,9 +1359,78 @@ class InferenceEngine:
         whole cache."""
         if self._free_blocks:
             return self._free_blocks.pop()
-        blk, freed = self._prefix_cache.pop_victim()
+        if self._kv_tier is not None:
+            # tiered eviction: the victim chain SPILLS (device->host
+            # copy, then the nodes stay matchable as "spilled") instead
+            # of vanishing; already-spilled nodes orphaned by a broken
+            # ancestor chain drop their tier payloads
+            spill: list = []
+            dropped: list = []
+            blk, freed = self._prefix_cache.pop_victim(
+                collect_spill=spill, dropped=dropped
+            )
+            self._spill_blocks(spill)
+            for d in dropped:
+                self._kv_tier.discard(d)
+        else:
+            blk, freed = self._prefix_cache.pop_victim()
+        # Invariant (and the reason the spill copy above cannot race a
+        # recycled block): an evicted chain's blocks carry ZERO table
+        # references when they reach the free list — pop_victim only
+        # ever frees ref-0 nodes, and the cache mirrors _block_refs
+        # exactly. A stale nonzero entry here would mean a slot still
+        # points at a block about to be rewritten. Pop the zero entries
+        # so dead blocks don't accumulate bookkeeping.
+        for b in freed:
+            stale = self._block_refs.pop(b, 0)
+            assert stale == 0, (
+                f"evicted block {b} still has {stale} table reference(s)"
+            )
+        stale = self._block_refs.pop(blk, 0)
+        assert stale == 0, (
+            f"evicted block {blk} still has {stale} table reference(s)"
+        )
         self._free_blocks.extend(freed)
         return blk
+
+    def _spill_blocks(self, items: list) -> None:
+        """Copy evicted blocks device->host into the tier, BEFORE the
+        caller recycles them. Reading the gather result orders after
+        every in-flight decode chunk (async dispatch: the pool handle
+        it consumed is the newest chunk's output), so the copy can
+        never observe a half-written block — and published ref-0 blocks
+        are never the target of in-flight writes anyway (writes land
+        only in private, referenced blocks). One fixed-shape batched
+        gather (+ device-side int8 quantization for float pools; int8
+        pools ship q + scales verbatim, so their restores are exact)
+        per _RESTORE_BATCH blocks: one compile total, one device sync
+        per batch instead of per block."""
+        R = _RESTORE_BATCH
+        for lo in range(0, len(items), R):
+            group = items[lo : lo + R]
+            idx = [blk for _, blk in group] + [0] * (R - len(group))
+            kq, ks, vq, vs = self._gather_chain_jit(
+                self.pool, jnp.asarray(idx, jnp.int32)
+            )
+            kq, ks = np.asarray(kq), np.asarray(ks)
+            vq, vs = np.asarray(vq), np.asarray(vs)
+            for n, (digest, _) in enumerate(group):
+                payload = pack_kv_payload(
+                    kq[:, n], ks[:, n], vq[:, n], vs[:, n]
+                )
+                self._kv_tier.put(digest, payload)
+                self.kv_spill_blocks += 1
+                self.kv_spill_bytes += len(payload)
+
+    def _on_tier_evict(self, digest: str) -> None:
+        """The tier aged out / lost a payload: prune the matching
+        spilled radix node so no future match promises a restore the
+        tier cannot honor. Subtree digests cascade (drop_spilled returns
+        them; discard() does not re-fire this callback)."""
+        dropped, freed = self._prefix_cache.drop_spilled(digest)
+        self._free_blocks.extend(freed)
+        for d in dropped:
+            self._kv_tier.discard(d)
 
     def _alloc(self, slot_idx: int, upto: int) -> bool:
         """Grow slot's table to cover [0, upto). False if pool exhausted
@@ -1199,6 +1480,132 @@ class InferenceEngine:
                 break
             matched.append(blk)
         return matched
+
+    def _match_prefix_tiered(self, prompt: list) -> tuple[list, list]:
+        """Tiered variant of :meth:`_match_prefix`: the walk continues
+        THROUGH spilled nodes. Returns ``(matched, spilled)`` — resident
+        block ids, then the digests of the spilled chain that extends
+        them (restorable from the host tier), jointly capped at the same
+        at-least-one-token-left bound. The spilled chain is contiguous:
+        a resident node cannot sit below a spilled one (restores revive
+        top-down), and the walk stops at the first gap either way."""
+        matched: list = []
+        spilled: list = []
+        if not self.prefix_cache_enabled:
+            return matched, spilled
+        bs = self.block_size
+        cur = self._prefix_cache.cursor()
+        for i in range((len(prompt) - 1) // bs):
+            step = cur.step_tiered(tuple(prompt[i * bs : (i + 1) * bs]))
+            if step is None:
+                break
+            kind, val = step
+            if kind == "res":
+                if spilled:  # defensive: see docstring
+                    break
+                matched.append(val)
+            else:
+                spilled.append(val)
+        return matched, spilled
+
+    def _restore_spilled(
+        self, slot_idx: int, prompt: list, base: int, spilled: list
+    ) -> int:
+        """Restore a spilled chain from the host tier into freshly
+        popped blocks — dequantize + scatter, batched ``_RESTORE_BATCH``
+        blocks per async jitted dispatch (overlapping any in-flight
+        decode chunks) — and revive the radix nodes with the new
+        blocks. ``base`` is the resident matched-block count (the chain
+        extends it). Payloads are prefetched host-side first, stopping
+        at the first miss/corrupt/failed one: that node is pruned
+        (digest dropped tier-side too) and the remaining tokens fall
+        back to recompute-prefill. Returns blocks restored; the caller
+        advances ``prefill_pos`` past them. Block budget was already
+        checked by _admit (restores consume the same ``need`` the
+        availability check counted)."""
+        bs = self.block_size
+        t0 = time.monotonic()
+        overlapped = self._dispatcher.in_flight > 0
+        # phase 1 (host): prefetch + validate the chain's payloads —
+        # all tier reads happen BEFORE any block pops, so eviction churn
+        # from our own pops can't invalidate a payload we still need
+        chain = []
+        for digest in spilled:
+            try:
+                payload = self._kv_tier.get(digest)
+            except Exception:  # noqa: BLE001 — any tier fault => recompute
+                payload = None
+            parsed = None
+            if payload is not None:
+                try:
+                    parsed = unpack_kv_payload(payload)
+                except ValueError:
+                    parsed = None
+            if parsed is None:
+                # miss / corrupt: degrade to recompute-prefill from here.
+                # Prune the dangling node (and its subtree's payloads) so
+                # the next admission doesn't re-promise this restore.
+                self.kv_restore_fallbacks += 1
+                dropped, freed = self._prefix_cache.drop_spilled(digest)
+                self._free_blocks.extend(freed)
+                self._kv_tier.discard(digest)
+                for d in dropped:
+                    self._kv_tier.discard(d)
+                break
+            chain.append(parsed)
+        if not chain:
+            return 0
+        # phase 2 (device): pop destination blocks, then scatter the
+        # chain in _RESTORE_BATCH groups — one fixed-shape dispatch per
+        # group, index lanes padded with scratch block 0
+        blks = [self._pop_block() for _ in range(len(chain))]
+        R = _RESTORE_BATCH
+        for lo in range(0, len(chain), R):
+            group = chain[lo : lo + R]
+            idx = blks[lo : lo + R]
+            pad = R - len(group)
+            kq = np.stack([g[0] for g in group], axis=1)
+            ks = np.stack([g[1] for g in group], axis=1)
+            vq = np.stack([g[2] for g in group], axis=1)
+            vs = np.stack([g[3] for g in group], axis=1)
+            if pad:
+                kq = np.pad(kq, ((0, 0), (0, pad), (0, 0), (0, 0), (0, 0)))
+                ks = np.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                vq = np.pad(vq, ((0, 0), (0, pad), (0, 0), (0, 0), (0, 0)))
+                vs = np.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            self.pool = self._restore_chain_jit(
+                self.pool,
+                jnp.asarray(idx + [0] * pad, jnp.int32),
+                jnp.asarray(kq),
+                jnp.asarray(ks),
+                jnp.asarray(vq),
+                jnp.asarray(vs),
+            )
+        # phase 3: revive the radix nodes and wire the slot table
+        cur = self._prefix_cache.cursor()
+        for i in range(base):
+            # reposition after the resident prefix; publish on existing
+            # nodes descends WITHOUT re-touching the LRU (the match walk
+            # in _admit already stamped them once)
+            cur.publish(
+                tuple(prompt[i * bs : (i + 1) * bs]),
+                int(self._tables[slot_idx, i]),
+                0,
+            )
+        for n, blk in enumerate(blks):
+            i = base + n
+            self._block_refs[blk] = 1
+            self._tables[slot_idx, i] = blk
+            self._nalloc[slot_idx] += 1
+            got = cur.publish(tuple(prompt[i * bs : (i + 1) * bs]), blk, 1)
+            assert got == blk, "restore revived a node another block holds"
+            self.kv_restore_hits += 1
+        restored = len(blks)
+        self._dispatcher.note_restores(restored, overlapped)
+        self._dispatcher.invalidate_table(slot_idx)
+        if self._kv_restore_hist is not None:
+            self._kv_restore_hist.observe(time.monotonic() - t0)
+        return restored
 
     def _publish_prefix_blocks(self, slot_idx: int) -> None:
         """Make this slot's fully-written full prompt blocks matchable.
@@ -1313,6 +1720,10 @@ class InferenceEngine:
         self._tables[:] = 0
         self._nalloc = [0] * self.max_slots
         self._prefix_cache.reset()
+        if self._kv_tier is not None:
+            # payloads are content-addressed, but the radix nodes that
+            # map digests to matches died with the cache — drop them
+            self._kv_tier.clear()
         self._block_refs.clear()
         # the keys array is an OUTPUT of the failed decode chain under
         # async dispatch — a poisoned future that would re-raise on the
@@ -1366,7 +1777,13 @@ class InferenceEngine:
         False (leaving the request queued) when the pool can't hold the
         prompt right now."""
         prompt = req.prompt_ids + req.tokens  # tokens: preempted resume
-        matched = self._match_prefix(prompt)
+        if self._kv_tier is not None:
+            matched, spilled = self._match_prefix_tiered(prompt)
+        else:
+            matched, spilled = self._match_prefix(prompt), []
+        # spilled blocks are NOT subtracted from need: each restore pops
+        # a fresh block, so they consume exactly the budget the
+        # availability check counts for them
         need = math.ceil(len(prompt) / self.block_size) - len(matched)
         # availability must not count the matched blocks themselves: a
         # ref-0 cached block we are about to reference is no longer
@@ -1383,16 +1800,27 @@ class InferenceEngine:
             self._prefix_cache.ref(blk)
             self._tables[slot_idx, i] = blk
         self._nalloc[slot_idx] = len(matched)
+        # restore the spilled extension of the matched chain (host->
+        # device, async) before the private pops — restored blocks are
+        # referenced, so the pops below can never evict them either
+        restored = (
+            self._restore_spilled(slot_idx, prompt, len(matched), spilled)
+            if spilled
+            else 0
+        )
         ok = self._alloc(slot_idx, len(prompt))
         assert ok, "availability was checked above"
-        self.prefix_hit_blocks += len(matched)
+        self.prefix_hit_blocks += len(matched) + restored
+        self.prefix_hit_tokens += (len(matched) + restored) * self.block_size
+        self.recompute_tokens_saved += restored * self.block_size
         slot = self.slots[slot_idx]
         slot.gen += 1  # new occupant: stale in-flight chunks must not emit
         slot.req = req
         slot.prompt = prompt
-        # skip straight past the cached prefix: its K/V is already in
-        # the pool; at least one prompt token remains (_match_prefix cap)
-        slot.prefill_pos = len(matched) * self.block_size
+        # skip straight past the cached prefix (resident matches plus
+        # tier restores): its K/V is already in the pool; at least one
+        # prompt token remains (_match_prefix cap)
+        slot.prefill_pos = (len(matched) + restored) * self.block_size
         slot.ready = False
         slot.draft_ready = False
         slot.length = len(prompt)
@@ -1584,6 +2012,8 @@ class InferenceEngine:
         req = slot.req
         if req is None:
             return
+        if self._kv_tier is not None:
+            self._publish_preempt_chain(i)
         slot.req = None
         slot.ready = False
         self._free_slot_blocks(i)
@@ -1591,6 +2021,36 @@ class InferenceEngine:
         self.requests_preempted += 1
         if self.telemetry is not None:
             self.telemetry.on_preempt(req)
+
+    def _publish_preempt_chain(self, i: int) -> None:
+        """Tiered preemption: publish the slot's fully-WRITTEN blocks
+        covering prompt + generated tokens before the blocks are freed,
+        so the chain stays matchable — under pressure it then spills to
+        the host tier and the resume admission RESTORES it instead of
+        re-prefilling the generated prefix (the recompute cost the
+        pressure leg pays). K/V is final for positions [0, length-1)
+        (the last emitted token's K/V is written by the step that
+        generates its successor), and every _preempt call site reaches
+        here with the dispatch window drained, so the blocks are
+        settled. Gated on the tier: the untiered engine keeps its exact
+        prior behavior (generated-suffix blocks were never published).
+
+        Mid-prefill slots need nothing — their full prompt blocks are
+        already published incrementally by _publish_prefix_blocks."""
+        slot = self.slots[i]
+        if not slot.ready or slot.req is None:
+            return
+        seq = slot.req.prompt_ids + slot.req.tokens
+        bs = self.block_size
+        n_full = (slot.length - 1) // bs
+        cur = self._prefix_cache.cursor()
+        for b in range(n_full):
+            blk = int(self._tables[i, b])
+            cur.publish(
+                tuple(seq[b * bs : (b + 1) * bs]),
+                blk,
+                self._block_refs.get(blk, 0),
+            )
 
     def _emit(self, slot_idx: int, token: int) -> None:
         slot = self.slots[slot_idx]

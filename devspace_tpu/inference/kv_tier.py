@@ -1,0 +1,304 @@
+"""Host-RAM (optionally disk-backed) tier for evicted KV blocks.
+
+Under KV oversubscription the engine used to throw cached work away:
+``RadixPrefixCache.pop_victim`` recycled a chain's pool blocks and the
+K/V they held was simply gone — a later hit on the same prefix (or a
+preempted request resuming) re-ran prefill from scratch. This module is
+the tier below the HBM pool (ROADMAP item 2; Mooncake-style KV store,
+RadixAttention-style chain reuse): before the engine recycles an evicted
+chain's blocks it copies them device->host, int8-quantized per block
+(``inference.quantization.quantize_kv_block``), and parks the payloads
+here. A radix match that lands on a spilled chain then restores
+host->device (one jitted scatter per block, async — it overlaps
+in-flight decode chunks) instead of recomputing prefill.
+
+Design mirrors ``sync/artifacts.py`` (the repo's content-addressed LRU
+precedent):
+
+- **Content-addressed**: keys are blake2b digests of the chain's token
+  blocks (computed incrementally by the radix tree,
+  ``prefix_cache.RadixPrefixCache(track_digests=True)``). A block's K/V
+  is a pure function of its token chain and absolute position, so equal
+  digests mean interchangeable payloads within an engine's lifetime.
+- **LRU-by-bytes**: an ``OrderedDict`` holding packed payloads, evicted
+  oldest-first when ``max_bytes`` overflows. With the disk level on
+  (``"host+disk"``), RAM evictions overflow to digest-named files under
+  their own byte budget instead of being dropped; reads promote back to
+  RAM.
+- **Checksummed**: every payload stores its own blake2b checksum, and
+  ``get`` re-verifies before returning — a corrupted payload (bit rot,
+  truncated file) is dropped and reported as a miss, never scattered
+  into the pool. The engine falls back to recompute-prefill on any miss.
+
+The engine's scheduler thread is the only mutator (no locks, like the
+prefix cache); ``stats()`` reads are GIL-atomic ints for /healthz.
+Dropped entries fire ``on_evict(digest)`` so the owner can prune the
+radix tree's spilled nodes — a dangling spilled node would promise a
+restore the tier can no longer honor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+_MAGIC = b"KVT1"
+_CHECKSUM_SIZE = 16
+
+
+def pack_kv_payload(
+    kq: np.ndarray, ks: np.ndarray, vq: np.ndarray, vs: np.ndarray
+) -> bytes:
+    """Pack one spilled block — int8 K/V ``[L, Hkv, bs, D]`` plus their
+    per-(layer, head, token) f32 scales ``[L, Hkv, bs]`` — into a
+    self-describing byte string: magic, dims, then the four raw buffers
+    in order. ~= bs * L * Hkv * (2D + 8) bytes, a ~2x (bf16) to ~3.6x
+    (f32) shrink versus the resident block."""
+    if kq.dtype != np.int8 or vq.dtype != np.int8:
+        raise ValueError("quantized K/V must be int8")
+    L, Hkv, bs, D = kq.shape
+    parts = [
+        _MAGIC,
+        struct.pack("<4I", L, Hkv, bs, D),
+        kq.tobytes(),
+        np.ascontiguousarray(ks, np.float32).tobytes(),
+        vq.tobytes(),
+        np.ascontiguousarray(vs, np.float32).tobytes(),
+    ]
+    return b"".join(parts)
+
+
+def unpack_kv_payload(
+    buf: bytes,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of :func:`pack_kv_payload`. Raises ValueError on any
+    structural mismatch (bad magic, short buffer) — the engine treats
+    that as a miss and recomputes."""
+    if buf[:4] != _MAGIC:
+        raise ValueError("bad KV payload magic")
+    L, Hkv, bs, D = struct.unpack_from("<4I", buf, 4)
+    n_q, n_s = L * Hkv * bs * D, L * Hkv * bs
+    want = 4 + 16 + 2 * n_q + 2 * 4 * n_s
+    if len(buf) != want:
+        raise ValueError(f"KV payload length {len(buf)} != expected {want}")
+    off = 20
+    kq = np.frombuffer(buf, np.int8, n_q, off).reshape(L, Hkv, bs, D)
+    off += n_q
+    ks = np.frombuffer(buf, np.float32, n_s, off).reshape(L, Hkv, bs)
+    off += 4 * n_s
+    vq = np.frombuffer(buf, np.int8, n_q, off).reshape(L, Hkv, bs, D)
+    off += n_q
+    vs = np.frombuffer(buf, np.float32, n_s, off).reshape(L, Hkv, bs)
+    return kq, ks, vq, vs
+
+
+def _checksum(payload: bytes) -> bytes:
+    return hashlib.blake2b(payload, digest_size=_CHECKSUM_SIZE).digest()
+
+
+class HostKVTier:
+    """Byte-budgeted host store for spilled KV blocks. See module
+    docstring for the design; the API is put/get/discard over digest
+    keys plus ``stats()`` for the engine's observability surface."""
+
+    def __init__(
+        self,
+        max_bytes: int = 256 << 20,
+        disk_dir: Optional[str] = None,
+        disk_max_bytes: int = 2 << 30,
+    ):
+        if max_bytes < 1:
+            raise ValueError("max_bytes must be >= 1")
+        self.max_bytes = int(max_bytes)
+        self.disk_dir = disk_dir
+        self.disk_max_bytes = int(disk_max_bytes)
+        # digest -> (payload, checksum); insertion/move order = LRU
+        self._ram: "OrderedDict[str, tuple[bytes, bytes]]" = OrderedDict()
+        self._ram_bytes = 0
+        self._disk: "OrderedDict[str, int]" = OrderedDict()  # digest -> nbytes
+        self._disk_bytes = 0
+        # fired when an entry leaves the tier ENTIRELY (dropped from RAM
+        # with no disk level, or aged off disk) — the engine prunes the
+        # matching spilled radix node so matches never dangle
+        self.on_evict: Optional[Callable[[str], None]] = None
+        self.puts = 0
+        self.hits = 0
+        self.misses = 0
+        self.corrupt_dropped = 0
+        self.evictions = 0
+
+    # -- internals ---------------------------------------------------------
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.disk_dir, f"{digest}.kv")
+
+    def _drop(self, digest: str) -> None:
+        """Entry left the tier entirely — tell the owner."""
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(digest)
+
+    def _ram_evict_overflow(self) -> None:
+        while self._ram_bytes > self.max_bytes and self._ram:
+            digest, (payload, checksum) = self._ram.popitem(last=False)
+            self._ram_bytes -= len(payload)
+            if self.disk_dir is not None:
+                self._disk_put(digest, payload, checksum)
+            else:
+                self._drop(digest)
+
+    def _disk_put(self, digest: str, payload: bytes, checksum: bytes) -> None:
+        try:
+            os.makedirs(self.disk_dir, exist_ok=True)
+            with open(self._path(digest), "wb") as f:
+                f.write(checksum)
+                f.write(payload)
+        except OSError:
+            self._drop(digest)  # disk refused it: gone for good
+            return
+        if digest in self._disk:
+            self._disk_bytes -= self._disk.pop(digest)
+        self._disk[digest] = _CHECKSUM_SIZE + len(payload)
+        self._disk_bytes += self._disk[digest]
+        while self._disk_bytes > self.disk_max_bytes and self._disk:
+            old, nbytes = self._disk.popitem(last=False)
+            self._disk_bytes -= nbytes
+            self._disk_unlink(old)
+            self._drop(old)
+
+    def _disk_unlink(self, digest: str) -> None:
+        try:
+            os.unlink(self._path(digest))
+        except OSError:
+            pass
+
+    def _disk_get(self, digest: str) -> Optional[bytes]:
+        nbytes = self._disk.pop(digest, None)
+        if nbytes is None:
+            return None
+        self._disk_bytes -= nbytes
+        try:
+            with open(self._path(digest), "rb") as f:
+                buf = f.read()
+        except OSError:
+            buf = b""
+        self._disk_unlink(digest)
+        checksum, payload = buf[:_CHECKSUM_SIZE], buf[_CHECKSUM_SIZE:]
+        if len(checksum) != _CHECKSUM_SIZE or _checksum(payload) != checksum:
+            self.corrupt_dropped += 1
+            return None
+        return payload
+
+    # -- api ---------------------------------------------------------------
+    def put(self, digest: str, payload: bytes) -> None:
+        """Retain one spilled block. Re-putting an existing digest
+        refreshes its LRU position (the payload is content-addressed —
+        equal digests mean equal bytes, so the old copy is kept)."""
+        self.puts += 1
+        if digest in self._ram:
+            self._ram.move_to_end(digest)
+            return
+        if digest in self._disk:  # promote-by-rewrite: RAM is the hot level
+            self._disk_bytes -= self._disk.pop(digest)
+            self._disk_unlink(digest)
+        self._ram[digest] = (payload, _checksum(payload))
+        self._ram_bytes += len(payload)
+        self._ram_evict_overflow()
+
+    def get(self, digest: str) -> Optional[bytes]:
+        """The payload for ``digest``, or None on miss. Integrity is
+        re-verified on EVERY read; a checksum mismatch drops the entry
+        and reports a miss — corrupted K/V is never handed back to be
+        scattered into the pool."""
+        entry = self._ram.get(digest)
+        if entry is not None:
+            payload, checksum = entry
+            if _checksum(payload) != checksum:
+                del self._ram[digest]
+                self._ram_bytes -= len(payload)
+                self.corrupt_dropped += 1
+                self.misses += 1
+                return None
+            self._ram.move_to_end(digest)
+            self.hits += 1
+            return payload
+        payload = self._disk_get(digest)
+        if payload is not None:
+            self.hits += 1
+            # promote: recently-restored chains are likely to be hit again
+            self._ram[digest] = (payload, _checksum(payload))
+            self._ram_bytes += len(payload)
+            self._ram_evict_overflow()
+            return payload
+        self.misses += 1
+        return None
+
+    def discard(self, digest: str) -> None:
+        """Forget ``digest`` without firing ``on_evict`` — the owner
+        already knows (it is the one discarding)."""
+        entry = self._ram.pop(digest, None)
+        if entry is not None:
+            self._ram_bytes -= len(entry[0])
+        nbytes = self._disk.pop(digest, None)
+        if nbytes is not None:
+            self._disk_bytes -= nbytes
+            self._disk_unlink(digest)
+
+    def clear(self) -> None:
+        """Drop everything (the pool whose content this tier holds is
+        gone — digests describe positions in a pool that no longer
+        exists... content survives pool resets in principle, but the
+        radix tree that maps digests to matches does not)."""
+        self._ram.clear()
+        self._ram_bytes = 0
+        for digest in list(self._disk):
+            self._disk_unlink(digest)
+        self._disk.clear()
+        self._disk_bytes = 0
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._ram) + len(self._disk)
+
+    @property
+    def resident_bytes(self) -> int:
+        """Host RAM held right now (the gauge; disk bytes are separate)."""
+        return self._ram_bytes
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._ram) + len(self._disk),
+            "ram_entries": len(self._ram),
+            "ram_bytes": self._ram_bytes,
+            "disk_entries": len(self._disk),
+            "disk_bytes": self._disk_bytes,
+            "puts": self.puts,
+            "hits": self.hits,
+            "misses": self.misses,
+            "corrupt_dropped": self.corrupt_dropped,
+            "evictions": self.evictions,
+        }
+
+
+def resolve_kv_tier(kv_tier: Optional[str]) -> str:
+    """Tier-mode resolution, mirroring ``resolve_dispatch_depth``: the
+    explicit constructor arg wins, then the ``DEVSPACE_KV_TIER`` env knob,
+    default off. Returns ``"off"``, ``"host"`` or ``"host+disk"``."""
+    val = (
+        str(kv_tier).strip().lower()
+        if kv_tier is not None
+        else os.environ.get("DEVSPACE_KV_TIER", "").strip().lower()
+    )
+    if val in ("", "off", "0", "false", "no", "none"):
+        return "off"
+    if val in ("host", "ram", "on", "true", "yes", "1"):
+        return "host"
+    if val in ("host+disk", "host_disk", "hostdisk", "disk"):
+        return "host+disk"
+    raise ValueError(
+        f"kv_tier must be off|host|host+disk, got {kv_tier!r}"
+    )

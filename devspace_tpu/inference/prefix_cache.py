@@ -40,22 +40,57 @@ The cache owns no pool blocks — it maps block ids it is told about and
 mirrors the engine's table refcounts via :meth:`ref`/:meth:`release`.
 Everything here is plain host Python: no jax, no locks (the engine's
 scheduler thread is the only caller).
+
+**Tiered mode** (``track_digests=True``, used when the engine runs a
+host KV tier — inference/kv_tier.py): nodes gain a third state beyond
+resident and gone. ``pop_victim(collect_spill=...)`` transitions the
+victim and its ref-0 descendants to **spilled** — they stay in the tree
+(their blocks are recycled, ``blk = -1``) so the chain remains
+matchable; ``Cursor.step_tiered`` keeps walking through them and
+reports their content digests, which the engine uses to restore the K/V
+from the host tier into fresh blocks (``Cursor.publish`` on a spilled
+node *revives* it with the restored block). Each node's digest is the
+incremental blake2b of its token chain from the root — the
+content-address the tier stores payloads under. With
+``track_digests=False`` (the default) no spilled node can ever exist
+and every code path below is byte-identical to the untiered cache.
+
+    resident --pop_victim(collect_spill)--> spilled --publish--> resident
+    resident --pop_victim()------------------------------------> gone
+    spilled --drop_spilled / broken ancestor chain-------------> gone
 """
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 from collections import OrderedDict
 from typing import Optional
+
+
+def _chain_digest(parent_digest: str, edge: tuple) -> str:
+    """Incremental content address: blake2b over the parent's digest and
+    this block's token tuple — equal digests iff equal token chains from
+    the root. O(block) per node, computed once at publish."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent_digest.encode("ascii"))
+    h.update(",".join(map(str, edge)).encode("ascii"))
+    return h.hexdigest()
 
 
 class _Node:
     """One published block: ``edge`` is the block's own token tuple (the
     child key under ``parent``), ``blk`` the pool block id, ``refs`` the
     mirrored table refcount, ``touch`` the LRU stamp (monotonic clock;
-    larger = more recently matched/published)."""
+    larger = more recently matched/published). ``digest`` is the chain
+    content address (tiered mode only, else None). State encoding:
+    resident (``blk >= 0``, in ``_by_block``), spilled (``blk == -1``,
+    in ``_spilled``, still in ``parent.children``), gone (detached).
+    ``live`` is the heap-validity flag: True only while resident."""
 
-    __slots__ = ("edge", "parent", "children", "blk", "refs", "touch", "live")
+    __slots__ = (
+        "edge", "parent", "children", "blk", "refs", "touch", "live", "digest",
+    )
 
     def __init__(self, edge, parent, blk, refs, touch):
         self.edge = edge
@@ -65,6 +100,7 @@ class _Node:
         self.refs = refs
         self.touch = touch
         self.live = True
+        self.digest: Optional[str] = None
 
 
 class Cursor:
@@ -80,13 +116,32 @@ class Cursor:
     def step(self, edge: tuple) -> Optional[int]:
         """Match one block: descend by ``edge`` and return the resident
         block id (touching it LRU-most-recent), or None when the chain
-        ends here. O(len(edge)) hashing."""
+        ends here — a SPILLED child also ends the resident walk (its
+        K/V is host-side; use :meth:`step_tiered` to keep matching
+        through it). O(len(edge)) hashing."""
         child = self._node.children.get(edge)
-        if child is None:
+        if child is None or child.blk < 0:
             return None
         self._cache._touch(child)
         self._node = child
         return child.blk
+
+    def step_tiered(self, edge: tuple) -> Optional[tuple[str, object]]:
+        """Tiered match step: ``("res", blk)`` for a resident child
+        (LRU-touched, like :meth:`step`), ``("spill", digest)`` for a
+        spilled one (no touch — spilled nodes are outside the LRU; the
+        engine restores the digest's payload into a fresh block and
+        revives the node via :meth:`publish`), None when the chain
+        ends."""
+        child = self._node.children.get(edge)
+        if child is None:
+            return None
+        if child.blk < 0:
+            self._node = child
+            return ("spill", child.digest)
+        self._cache._touch(child)
+        self._node = child
+        return ("res", child.blk)
 
     def publish(self, edge: tuple, blk: int, refs: int) -> int:
         """Publish one block: descend by ``edge``, inserting a node for
@@ -95,14 +150,32 @@ class Cursor:
         inserted, the first writer's block when the content is already
         cached (the caller's copy stays private). Existing entries are
         NOT LRU-touched (publish never reorders, matching the flat
-        map)."""
+        map). Publishing onto a SPILLED node revives it with ``blk`` —
+        the restore path (the tier's payload scattered into a fresh
+        block) and the recompute-fallback republish both land here."""
         child = self._node.children.get(edge)
         if child is not None:
+            if child.blk >= 0:
+                self._node = child
+                return child.blk
+            cache = self._cache
+            cache._clock += 1
+            child.blk = blk
+            child.refs = refs
+            child.touch = cache._clock
+            child.live = True
+            cache._by_block[blk] = child
+            cache._spilled.pop(child.digest, None)
+            if refs == 0:
+                cache._evictable += 1
+                heapq.heappush(cache._heap, (child.touch, id(child), child))
             self._node = child
-            return child.blk
+            return blk
         cache = self._cache
         cache._clock += 1
         node = _Node(edge, self._node, blk, refs, cache._clock)
+        if cache._track_digests:
+            node.digest = _chain_digest(self._node.digest or "", edge)
         self._node.children[edge] = node
         cache._by_block[blk] = node
         if refs == 0:
@@ -113,12 +186,23 @@ class Cursor:
 
 
 class RadixPrefixCache:
-    """Tree-structured published-block index. See module docstring."""
+    """Tree-structured published-block index. See module docstring.
 
-    def __init__(self):
+    ``track_digests=True`` enables tiered mode: nodes carry chain
+    content digests and eviction can SPILL chains (keep them matchable
+    with their K/V parked host-side) instead of dropping them. Off by
+    default — the engine turns it on only with a host tier attached, so
+    the untiered engine pays zero digest hashing and behaves
+    byte-identically to before."""
+
+    def __init__(self, track_digests: bool = False):
+        self._track_digests = bool(track_digests)
         self._root = _Node(None, None, -1, 0, 0)
         self._root.live = False  # never a victim
+        self._root.digest = ""  # digest chain anchor
         self._by_block: dict[int, _Node] = {}
+        # digest -> spilled node (tiered mode; empty otherwise)
+        self._spilled: dict[str, _Node] = {}
         self._clock = 0
         # lazy min-heap of (touch, tiebreak, node) eviction candidates:
         # entries go stale when the node is re-touched, re-referenced or
@@ -130,6 +214,10 @@ class RadixPrefixCache:
     # -- introspection ----------------------------------------------------
     def __len__(self) -> int:
         return len(self._by_block)
+
+    def spilled_count(self) -> int:
+        """Spilled (host-tier-backed) nodes currently matchable."""
+        return len(self._spilled)
 
     def is_published(self, blk: int) -> bool:
         return blk in self._by_block
@@ -179,7 +267,11 @@ class RadixPrefixCache:
             heapq.heappush(self._heap, (node.touch, id(node), node))
 
     # -- eviction ----------------------------------------------------------
-    def pop_victim(self) -> tuple[int, list[int]]:
+    def pop_victim(
+        self,
+        collect_spill: Optional[list] = None,
+        dropped: Optional[list] = None,
+    ) -> tuple[int, list[int]]:
         """Reclaim the least-recently-touched ref-0 block for private
         reuse. Returns ``(victim_blk, freed)`` where ``freed`` lists the
         victim's ref-0 DESCENDANT blocks, unpublished along with it (the
@@ -187,7 +279,18 @@ class RadixPrefixCache:
         straight back to the allocator's free list; in-use descendants
         are unpublished so their table release frees them). Cost is the
         heap pop plus a walk of the evicted subtree — never a scan of
-        the whole cache. Raises RuntimeError when nothing is evictable."""
+        the whole cache. Raises RuntimeError when nothing is evictable.
+
+        Tiered mode: with ``collect_spill`` a list (and digests
+        tracked), the victim and its ref-0 descendants transition to
+        SPILLED instead of gone — they stay in the tree, matchable
+        through :meth:`Cursor.step_tiered` — and ``(digest, blk)`` pairs
+        are appended for the engine to copy device->host BEFORE reusing
+        the returned blocks. In-use descendants still go gone (their
+        chain would need the evicted ancestors resident to match...
+        they re-publish on their own), and any already-spilled node
+        below a gone one is pruned — its digest is appended to
+        ``dropped`` so the caller can discard the tier payload."""
         victim = None
         while self._heap:
             touch, _, node = heapq.heappop(self._heap)
@@ -196,17 +299,46 @@ class RadixPrefixCache:
                 break
         if victim is None:
             raise RuntimeError("allocator invariant: no block available")
-        del victim.parent.children[victim.edge]
-        self._unpublish(victim)
+        spill = collect_spill is not None and self._track_digests
         freed: list[int] = []
-        stack = list(victim.children.values())
+        victim_blk = victim.blk  # _spill_node overwrites blk with -1
+        if spill:
+            collect_spill.append((victim.digest, victim.blk))
+            self._spill_node(victim)
+        else:
+            del victim.parent.children[victim.edge]
+            self._unpublish(victim)
+        # (node, chain_ok): ok while every ancestor up to the victim is
+        # itself spilled — a spilled node is restorable only through an
+        # unbroken ancestor line
+        stack = [(n, spill) for n in victim.children.values()]
         while stack:
-            n = stack.pop()
-            self._unpublish(n)
-            if n.refs == 0:
+            n, ok = stack.pop()
+            if n.blk < 0:  # spilled by an earlier eviction
+                if not ok:
+                    self._spilled.pop(n.digest, None)
+                    if dropped is not None:
+                        dropped.append(n.digest)
+                    del n.parent.children[n.edge]
+                    n.live = False
+                stack.extend((c, ok) for c in n.children.values())
+                continue
+            if ok and n.refs == 0:
+                collect_spill.append((n.digest, n.blk))
                 freed.append(n.blk)
-            stack.extend(n.children.values())
-        return victim.blk, freed
+                self._spill_node(n)
+                stack.extend((c, True) for c in n.children.values())
+            else:
+                if spill:
+                    # the victim stays in the tree, so gone descendants
+                    # must detach explicitly (untiered eviction detaches
+                    # the whole subtree at the victim)
+                    del n.parent.children[n.edge]
+                self._unpublish(n)
+                if n.refs == 0:
+                    freed.append(n.blk)
+                stack.extend((c, False) for c in n.children.values())
+        return victim_blk, freed
 
     def _unpublish(self, node: _Node) -> None:
         del self._by_block[node.blk]
@@ -214,11 +346,55 @@ class RadixPrefixCache:
         if node.refs == 0:
             self._evictable -= 1
 
+    def _spill_node(self, node: _Node) -> None:
+        """resident -> spilled: out of ``_by_block`` and the eviction
+        pool (its block is being recycled), but still in the tree and
+        indexed by digest for restores. Only ref-0 nodes spill."""
+        del self._by_block[node.blk]
+        node.live = False
+        self._evictable -= 1
+        node.blk = -1
+        self._spilled[node.digest] = node
+
+    def drop_spilled(self, digest: str) -> tuple[list[str], list[int]]:
+        """Prune a spilled node whose payload the host tier no longer
+        holds (restore miss, corrupt payload, tier LRU eviction) — a
+        dangling spilled node would promise restores forever. The whole
+        subtree goes with it (nothing below is matchable without it).
+        Returns ``(dropped_digests, freed_blocks)``: descendant spilled
+        digests for the caller to discard from the tier, plus the
+        blocks of any resident ref-0 descendants (defensive — the
+        spill/restore protocol revives top-down, so resident nodes
+        below a spilled one should not arise). No-op for unknown
+        digests."""
+        node = self._spilled.pop(digest, None)
+        dropped: list[str] = []
+        freed: list[int] = []
+        if node is None:
+            return dropped, freed
+        del node.parent.children[node.edge]
+        node.live = False
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            if n.blk < 0:
+                self._spilled.pop(n.digest, None)
+                dropped.append(n.digest)
+                n.live = False
+            else:
+                self._unpublish(n)
+                if n.refs == 0:
+                    freed.append(n.blk)
+            stack.extend(n.children.values())
+        return dropped, freed
+
     def reset(self) -> None:
         """Drop everything (the pool the blocks indexed is gone)."""
         self._root = _Node(None, None, -1, 0, 0)
         self._root.live = False
+        self._root.digest = ""
         self._by_block.clear()
+        self._spilled.clear()
         self._heap.clear()
         self._evictable = 0
 

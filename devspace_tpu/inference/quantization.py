@@ -22,6 +22,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+# scale floor shared with ops.paged_attention.quantize_kv — an all-zero
+# vector quantizes to zeros with a tiny positive scale instead of NaNs
+KV_SCALE_EPS = 1e-8
 
 # transformer matmul leaves worth quantizing (norms/embeddings stay f32 —
 # embeddings are gathers, not matmuls, and norms are tiny)
@@ -109,6 +114,32 @@ def dequantize_params(params: dict):
 
     return jax.tree_util.tree_map(
         leaf, params, is_leaf=lambda x: isinstance(x, QuantizedLinear)
+    )
+
+
+def quantize_kv_block(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric int8 quantization of one KV block ``[L, Hkv, bs, D]``
+    for the host tier (inference/kv_tier.py): per-(layer, head, token)
+    scale chosen so max|x| over the head dim D maps to 127 — the SAME
+    convention as ``ops.paged_attention.quantize_kv``, so a spilled
+    block from a float pool carries exactly the noise profile the int8
+    pool already documents (~0.5%, greedy near-ties can flip). Runs on
+    the spill path host-side (plain numpy, no device dispatch)."""
+    x32 = np.asarray(x, np.float32)
+    amax = np.max(np.abs(x32), axis=-1)  # [L, Hkv, bs]
+    scale = np.maximum(amax, KV_SCALE_EPS) / 127.0
+    q = np.clip(np.rint(x32 / scale[..., None]), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequantize_kv_block(
+    q: np.ndarray, scale: np.ndarray, dtype=np.float32
+) -> np.ndarray:
+    """Host-side inverse of :func:`quantize_kv_block` (tests and
+    debugging; the engine's restore path dequantizes device-side inside
+    the jitted scatter to halve H2D traffic)."""
+    return (q.astype(np.float32) * np.asarray(scale, np.float32)[..., None]).astype(
+        dtype
     )
 
 

@@ -16,6 +16,11 @@ train->serve seam (DRAFT_CHECKPOINT for the draft); QUANTIZE=int8 serves
 weight-only-quantized; PREWARM=1 compiles every serving program before
 the port opens (no mid-serving XLA compiles); MAX_SLOTS / CHUNK_MAX /
 SPEC / SPEC_K / DRAFT_MODEL / PORT as below.
+
+CLI: --kv-tier off|host|host+disk spills evicted prefix chains to host
+RAM (optionally overflowing to disk) and restores them on radix hits
+instead of recomputing prefill (docs/inference.md "KV tiering"). The
+DEVSPACE_KV_TIER env var is the fallback when the flag is omitted.
 """
 
 import json
@@ -35,7 +40,7 @@ class SpecDisabled(RuntimeError):
 
 
 class Server:
-    def __init__(self):
+    def __init__(self, kv_tier=None):
         name = os.environ.get("MODEL", "tiny")
         self.cfg = CONFIGS[name]
         print(f"loading {name} ({self.cfg.n_layers} layers) on {jax.devices()[0]}")
@@ -127,6 +132,10 @@ class Server:
             dispatch_depth=(
                 1 if os.environ.get("ENGINE_OVERLAP") == "off" else None
             ),
+            # --kv-tier (DEVSPACE_KV_TIER when None): spill evicted
+            # prefix chains to host RAM, restore on radix hit instead
+            # of recomputing prefill (docs/inference.md "KV tiering")
+            kv_tier=kv_tier,
         )
         # PREWARM=1 compiles every prefill bucket / decode chunk / spec
         # program before the port opens — no mid-serving XLA compiles
@@ -202,10 +211,22 @@ class Server:
         return req.result(timeout=600)
 
 
-def main():
+def main(argv=None):
+    import argparse
     import http.server
 
-    server = Server()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--kv-tier",
+        choices=["off", "host", "host+disk"],
+        default=None,
+        help="spill evicted KV prefix chains to host RAM (optionally "
+        "disk-backed) and restore them on radix hits; defaults to "
+        "$DEVSPACE_KV_TIER, else off",
+    )
+    args = ap.parse_args(argv)
+
+    server = Server(kv_tier=args.kv_tier)
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, *args):

@@ -485,39 +485,73 @@ def _pressure_phase(params, rng) -> dict:
             f"raise BENCH_PRESSURE_NEW",
             file=sys.stderr,
         )
-    engine = InferenceEngine(
-        params,
-        CFG,
-        max_slots=p_slots,
-        max_len=p_len,
-        chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
-        block_size=p_block,
-        n_blocks=p_blocks,
-        kv_dtype=KV_DTYPE,
-        dispatch_depth=DISPATCH_DEPTH,
-    ).start()
-    try:
-        # compile wave: short generations, pool barely touched
-        warm_prompts = [
-            list(rng.integers(1, 1000, size=16)) for _ in range(p_slots)
-        ]
-        for h in [engine.submit(p, 4) for p in warm_prompts]:
-            h.result(timeout=600)
-        pre_before = engine.requests_preempted
-        t0 = time.time()
-        stream_h = engine.submit(list(rng.integers(1, 1000, size=p_prompt)), p_new)
-        rest = [
-            engine.submit(list(rng.integers(1, 1000, size=p_prompt)), p_new)
-            for _ in range(p_slots - 1)
-        ]
-        parrivals = _stream_arrivals(stream_h, timeout=1800)
-        for h in rest:
-            h.result(timeout=1800)
-        pressure_s = time.time() - t0
-        preemptions = engine.requests_preempted - pre_before
-    finally:
-        engine.stop()
+    warm_prompts = [
+        list(rng.integers(1, 1000, size=16)) for _ in range(p_slots)
+    ]
+    arm_prompts = [
+        list(rng.integers(1, 1000, size=p_prompt)) for _ in range(p_slots)
+    ]
+
+    def run_arm(kv_tier):
+        engine = InferenceEngine(
+            params,
+            CFG,
+            max_slots=p_slots,
+            max_len=p_len,
+            chunk_max=int(os.environ.get("BENCH_CHUNK", 8)),
+            block_size=p_block,
+            n_blocks=p_blocks,
+            kv_dtype=KV_DTYPE,
+            dispatch_depth=DISPATCH_DEPTH,
+            kv_tier=kv_tier,
+        ).start()
+        try:
+            # compile wave: short generations, pool barely touched
+            for h in [engine.submit(p, 4) for p in warm_prompts]:
+                h.result(timeout=600)
+            pre_before = engine.requests_preempted
+            t0 = time.time()
+            stream_h = engine.submit(arm_prompts[0], p_new)
+            rest = [engine.submit(p, p_new) for p in arm_prompts[1:]]
+            arrivals = _stream_arrivals(stream_h, timeout=1800)
+            for h in rest:
+                h.result(timeout=1800)
+            elapsed = time.time() - t0
+            preempted = engine.requests_preempted - pre_before
+            st = engine.stats()
+        finally:
+            engine.stop()
+        return elapsed, preempted, arrivals, st
+
+    pressure_s, preemptions, parrivals, _ = run_arm("off")
     pressure_tok = p_slots * p_new
+    # tier A/B (ISSUE 7): the SAME prompts/pool with the host KV tier on
+    # — preempted chains spill to host RAM and resume by restoring
+    # instead of recomputing prefill (BENCH_KV_TIER=off skips the arm)
+    tier_ab = None
+    if os.environ.get("BENCH_KV_TIER", "host") != "off":
+        tier_s, tier_pre, _, tier_st = run_arm("host")
+        tier_ab = {
+            "kv_pressure_tok_per_sec": round(pressure_tok / tier_s, 1),
+            "kv_pressure_off_tok_per_sec": round(
+                pressure_tok / pressure_s, 1
+            ),
+            "kv_pressure_speedup": round(pressure_s / tier_s, 2),
+            "kv_restore_hit_rate": tier_st["kv_restore_hit_rate"],
+            "kv_restore_hits": tier_st["kv_restore_hits"],
+            "kv_restore_fallbacks": tier_st["kv_restore_fallbacks"],
+            "kv_spill_blocks": tier_st["kv_spill_blocks"],
+            "preemptions": tier_pre,
+            "preemptions_off": preemptions,
+        }
+        print(
+            f"[inf-bench] kv-tier A/B: {tier_ab['kv_pressure_tok_per_sec']} "
+            f"tok/s tier-on vs {tier_ab['kv_pressure_off_tok_per_sec']} "
+            f"tier-off ({tier_ab['kv_pressure_speedup']}x), "
+            f"{tier_ab['kv_restore_hits']} restores, preemptions "
+            f"{tier_pre}/{preemptions}",
+            file=sys.stderr,
+        )
     stats = _arrival_stats(parrivals)
     print(
         f"[inf-bench] under {oversubscription:.2f}x KV oversubscription: "
@@ -540,6 +574,7 @@ def _pressure_phase(params, rng) -> dict:
         "pool_blocks": p_blocks,
         "demand_blocks": demand_blocks,
         "interarrival_ms": stats,
+        "tier_ab": tier_ab,
     }
 
 

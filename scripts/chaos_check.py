@@ -39,6 +39,11 @@ REQUIRED_CHAOS_MODULES = (
     # metric consistency under injected failures (ISSUE 6 satellite):
     # failure counters must increment exactly once per failed unit
     "test_obs_chaos",
+    # tiered KV degradation ladder (ISSUE 7): a restore failure
+    # mid-flight must fall back to recompute-prefill, and a corrupted
+    # spilled payload must be dropped on digest mismatch, never
+    # scattered into the pool
+    "test_kv_tier",
 )
 
 

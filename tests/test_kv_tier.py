@@ -1,0 +1,614 @@
+"""Tiered KV cache suite (inference/kv_tier.py — ISSUE 7).
+
+Three layers: HostKVTier unit behavior (LRU-by-bytes, disk overflow +
+promotion, checksum verification, eviction callbacks), the radix tree's
+third node state (spill / match-through / revive / prune), and
+engine-level equivalence — the tier must be INVISIBLE in outputs:
+byte-identical token streams with the tier off, on, and disk-backed,
+across eviction pressure, preemption and speculative interleave. The
+chaos-marked cases pin the degradation ladder: a restore failure
+mid-flight falls back to recompute-prefill, and a corrupted spilled
+payload is dropped on digest mismatch — never scattered into the pool.
+Satellites pinned here too: the ``prefix_hit_tokens`` /
+``recompute_tokens_saved`` stats goldens and the ``_pop_block``
+``_block_refs`` bookkeeping invariant.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from devspace_tpu.inference import InferenceEngine
+from devspace_tpu.inference.kv_tier import (
+    HostKVTier,
+    _checksum,
+    pack_kv_payload,
+    resolve_kv_tier,
+    unpack_kv_payload,
+)
+from devspace_tpu.inference.prefix_cache import RadixPrefixCache
+from devspace_tpu.inference.quantization import (
+    dequantize_kv_block,
+    quantize_kv_block,
+)
+from devspace_tpu.models import transformer as tfm
+
+CFG = tfm.TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _payload(seed=0, shape=(2, 2, 4, 8)):
+    rng = np.random.default_rng(seed)
+    kq = rng.integers(-127, 128, size=shape).astype(np.int8)
+    vq = rng.integers(-127, 128, size=shape).astype(np.int8)
+    ks = rng.random(shape[:3], dtype=np.float32)
+    vs = rng.random(shape[:3], dtype=np.float32)
+    return pack_kv_payload(kq, ks, vq, vs), (kq, ks, vq, vs)
+
+
+# -- payload format --------------------------------------------------------
+def test_pack_unpack_roundtrip():
+    buf, (kq, ks, vq, vs) = _payload()
+    kq2, ks2, vq2, vs2 = unpack_kv_payload(buf)
+    np.testing.assert_array_equal(kq, kq2)
+    np.testing.assert_array_equal(vq, vq2)
+    np.testing.assert_array_equal(ks, ks2)
+    np.testing.assert_array_equal(vs, vs2)
+
+
+def test_unpack_rejects_bad_magic_and_truncation():
+    buf, _ = _payload()
+    with pytest.raises(ValueError, match="magic"):
+        unpack_kv_payload(b"XXXX" + buf[4:])
+    with pytest.raises(ValueError, match="length"):
+        unpack_kv_payload(buf[:-1])
+    with pytest.raises(ValueError):
+        pack_kv_payload(
+            np.zeros((1, 1, 2, 4), np.float32),  # not int8
+            np.ones((1, 1, 2), np.float32),
+            np.zeros((1, 1, 2, 4), np.int8),
+            np.ones((1, 1, 2), np.float32),
+        )
+
+
+def test_quantize_kv_block_roundtrip_accuracy():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 2, 8, 16)).astype(np.float32)
+    q, scale = quantize_kv_block(x)
+    assert q.dtype == np.int8 and scale.shape == (2, 2, 8)
+    deq = dequantize_kv_block(q, scale)
+    rel = np.abs(deq - x).max() / np.abs(x).max()
+    assert rel < 0.01  # the ~0.5% int8 noise profile, with headroom
+    # all-zero rows quantize cleanly (scale floor, no NaN)
+    q0, s0 = quantize_kv_block(np.zeros((1, 1, 2, 4), np.float32))
+    assert not np.isnan(s0).any() and (q0 == 0).all()
+    np.testing.assert_array_equal(dequantize_kv_block(q0, s0), 0)
+
+
+# -- HostKVTier ------------------------------------------------------------
+def test_tier_lru_by_bytes_eviction_order():
+    buf, _ = _payload()
+    tier = HostKVTier(max_bytes=len(buf) * 2)
+    gone = []
+    tier.on_evict = gone.append
+    tier.put("a", buf)
+    tier.put("b", buf)
+    tier.get("a")  # refresh: b is now oldest
+    tier.put("c", buf)
+    assert gone == ["b"]
+    assert tier.get("b") is None and tier.get("a") is not None
+    assert tier.resident_bytes == len(buf) * 2
+    assert tier.stats()["evictions"] == 1
+
+
+def test_tier_reput_refreshes_lru_without_duplicating_bytes():
+    buf, _ = _payload()
+    tier = HostKVTier(max_bytes=len(buf) * 2)
+    tier.put("a", buf)
+    tier.put("b", buf)
+    tier.put("a", buf)  # refresh, not duplicate
+    assert tier.resident_bytes == len(buf) * 2
+    tier.put("c", buf)  # now b (oldest) ages out, a survives
+    assert tier.get("a") is not None and tier.get("b") is None
+
+
+def test_tier_disk_overflow_and_promotion(tmp_path):
+    buf, _ = _payload()
+    tier = HostKVTier(max_bytes=len(buf), disk_dir=str(tmp_path))
+    gone = []
+    tier.on_evict = gone.append
+    tier.put("a", buf)
+    tier.put("b", buf)  # a overflows to disk, not dropped
+    assert gone == []
+    st = tier.stats()
+    assert st["ram_entries"] == 1 and st["disk_entries"] == 1
+    assert os.path.exists(tmp_path / "a.kv")
+    # read promotes a back to RAM (and b overflows down)
+    assert tier.get("a") == buf
+    st = tier.stats()
+    assert st["ram_entries"] == 1 and st["disk_entries"] == 1
+    assert not os.path.exists(tmp_path / "a.kv")
+    assert len(tier) == 2
+
+
+def test_tier_disk_budget_ages_off_end_of_tier(tmp_path):
+    buf, _ = _payload()
+    tier = HostKVTier(
+        max_bytes=len(buf),
+        disk_dir=str(tmp_path),
+        disk_max_bytes=(len(buf) + 16) * 2,
+    )
+    gone = []
+    tier.on_evict = gone.append
+    for d in "abcd":
+        tier.put(d, buf)
+    # a,b,c overflowed to disk in order; disk holds 2 -> a aged off
+    assert gone == ["a"]
+    assert tier.get("a") is None
+    assert tier.get("b") == buf  # promoted back from disk
+
+
+def test_tier_corrupt_ram_payload_dropped_as_miss():
+    buf, _ = _payload()
+    tier = HostKVTier()
+    tier.put("a", buf)
+    payload, checksum = tier._ram["a"]
+    bad = bytearray(payload)
+    bad[30] ^= 0xFF
+    tier._ram["a"] = (bytes(bad), checksum)
+    assert tier.get("a") is None
+    assert tier.stats()["corrupt_dropped"] == 1
+    assert "a" not in tier._ram and tier.resident_bytes == 0
+
+
+def test_tier_corrupt_disk_file_dropped_as_miss(tmp_path):
+    buf, _ = _payload()
+    tier = HostKVTier(max_bytes=len(buf), disk_dir=str(tmp_path))
+    tier.put("a", buf)
+    tier.put("b", buf)  # a -> disk
+    path = tmp_path / "a.kv"
+    raw = bytearray(path.read_bytes())
+    raw[40] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    assert tier.get("a") is None
+    assert tier.stats()["corrupt_dropped"] == 1
+    assert not path.exists()
+
+
+def test_tier_discard_is_silent(tmp_path):
+    buf, _ = _payload()
+    tier = HostKVTier(max_bytes=len(buf), disk_dir=str(tmp_path))
+    gone = []
+    tier.on_evict = gone.append
+    tier.put("a", buf)
+    tier.put("b", buf)  # a on disk, b in RAM
+    tier.discard("a")
+    tier.discard("b")
+    tier.discard("nope")
+    assert gone == [] and len(tier) == 0 and tier.resident_bytes == 0
+    assert not os.path.exists(tmp_path / "a.kv")
+
+
+def test_resolve_kv_tier_modes(monkeypatch):
+    assert resolve_kv_tier(None) == "off"
+    assert resolve_kv_tier("host") == "host"
+    assert resolve_kv_tier("HOST+DISK") == "host+disk"
+    monkeypatch.setenv("DEVSPACE_KV_TIER", "host")
+    assert resolve_kv_tier(None) == "host"
+    assert resolve_kv_tier("off") == "off"  # explicit arg wins
+    with pytest.raises(ValueError):
+        resolve_kv_tier("sideways")
+
+
+# -- radix tree: the third node state --------------------------------------
+def _publish_chain(cache, blocks, start_blk=1):
+    cur = cache.cursor()
+    for i, edge in enumerate(blocks):
+        cur.publish(tuple(edge), start_blk + i, 0)
+    return cur
+
+
+def test_spill_keeps_chain_matchable_and_revivable():
+    cache = RadixPrefixCache(track_digests=True)
+    edges = [(1, 2), (3, 4), (5, 6)]
+    _publish_chain(cache, edges)
+    spill, dropped = [], []
+    # evict the whole chain root-first -> all three spill
+    blk, freed = cache.pop_victim(collect_spill=spill, dropped=dropped)
+    assert blk == 1 and sorted(freed) == [2, 3]
+    assert len(spill) == 3 and dropped == []
+    assert cache.spilled_count() == 3 and cache.evictable() == 0
+    # plain step refuses spilled nodes; step_tiered walks through them
+    cur = cache.cursor()
+    assert cur.step((1, 2)) is None
+    cur = cache.cursor()
+    kinds = [cur.step_tiered(e) for e in edges]
+    assert [k[0] for k in kinds] == ["spill"] * 3
+    assert kinds[0][1] == spill[0][0]  # digest order matches spill order
+    # revive mid-chain: publish makes the node resident again
+    cur = cache.cursor()
+    cur.publish(edges[0], 7, 1)
+    assert cache.spilled_count() == 2
+    cur2 = cache.cursor()
+    assert cur2.step(edges[0]) == 7
+    assert cur2.step_tiered(edges[1])[0] == "spill"
+
+
+def test_drop_spilled_prunes_subtree_and_reports_digests():
+    cache = RadixPrefixCache(track_digests=True)
+    edges = [(1, 2), (3, 4), (5, 6)]
+    _publish_chain(cache, edges)
+    spill, dropped = [], []
+    cache.pop_victim(collect_spill=spill, dropped=dropped)
+    top_digest = spill[0][0]
+    gone_digests, freed = cache.drop_spilled(top_digest)
+    assert sorted(gone_digests) == sorted(d for d, _ in spill[1:])
+    assert freed == [] and cache.spilled_count() == 0
+    cur = cache.cursor()
+    assert cur.step_tiered(edges[0]) is None
+    # unknown digest is a no-op
+    assert cache.drop_spilled("beef") == ([], [])
+
+
+def test_broken_ancestor_chain_drops_orphaned_spilled_nodes():
+    cache = RadixPrefixCache(track_digests=True)
+    _publish_chain(cache, [(1, 2), (3, 4)])
+    cache.cursor().step((1, 2))  # refresh parent: child is now LRU
+    spill = []
+    # evict the child first: only (3,4) spills, its parent stays resident
+    cache.pop_victim(collect_spill=spill, dropped=[])
+    assert len(spill) == 1 and cache.spilled_count() == 1
+    # parent evicted WITHOUT spilling (untiered call): the orphaned
+    # spilled child must be pruned and its digest reported
+    dropped = []
+    cache.pop_victim(dropped=dropped)
+    assert dropped == [spill[0][0]]
+    assert cache.spilled_count() == 0
+    cur = cache.cursor()
+    assert cur.step_tiered((1, 2)) is None
+
+
+def test_tier_off_default_has_no_digest_overhead():
+    cache = RadixPrefixCache()  # track_digests=False
+    _publish_chain(cache, [(1, 2), (3, 4)])
+    spill = []
+    blk, freed = cache.pop_victim(collect_spill=spill)
+    # without digests nothing can spill: old semantics exactly
+    assert spill == [] and blk == 1 and freed == [2]
+    assert cache.spilled_count() == 0
+
+
+# -- engine equivalence: the tier must be invisible in outputs -------------
+def _run(params, reqs, kv_tier="off", waves=None, **kw):
+    """Serve requests (optionally in sequential waves to force eviction
+    between them) and return (streams, stats)."""
+    defaults = dict(
+        max_slots=2, max_len=64, block_size=8, n_blocks=10,
+        prefill_chunk=8, chunk_max=4,
+    )
+    defaults.update(kw)
+    engine = InferenceEngine(params, CFG, kv_tier=kv_tier, **defaults).start()
+    outs = []
+    try:
+        if waves:
+            for lo, hi in waves:
+                hs = [engine.submit(**r) for r in reqs[lo:hi]]
+                outs.extend(h.result(timeout=600) for h in hs)
+        else:
+            hs = [engine.submit(**r) for r in reqs]
+            outs = [h.result(timeout=600) for h in hs]
+        st = engine.stats()
+    finally:
+        engine.stop()
+    return outs, st
+
+
+def _spill_restore_trace(seed=1, tail=(7, 9)):
+    """Seed a prefix, flood it out of the pool, then re-hit it: wave
+    boundaries force the eviction (spill) and the re-hit (restore)."""
+    rng = np.random.default_rng(seed)
+    shared = [int(t) for t in rng.integers(2, 200, size=24)]
+    reqs = [dict(prompt_ids=shared, max_new_tokens=8)]
+    for _ in range(4):
+        reqs.append(dict(
+            prompt_ids=[int(t) for t in rng.integers(2, 200, size=24)],
+            max_new_tokens=8,
+        ))
+    reqs.append(dict(prompt_ids=shared + list(tail), max_new_tokens=8))
+    waves = [(i, i + 1) for i in range(len(reqs))]
+    return reqs, waves
+
+
+# Tier-off baselines are pure functions of (trace, engine kw) — memoized
+# so tests sharing a trace pay the engine build + compile once per
+# process (each engine costs seconds of XLA compiles on a 1-core CI
+# box). Keys are explicit, not derived, so a kw drift can't silently
+# alias two different baselines.
+_OFF_BASELINES: dict = {}
+
+
+def _off_baseline(key, params, reqs, waves, **kw):
+    if key not in _OFF_BASELINES:
+        _OFF_BASELINES[key] = _run(params, reqs, "off", waves, **kw)
+    return _OFF_BASELINES[key]
+
+
+def test_restore_streams_identical_and_saves_recompute(params):
+    """int8 KV pool: the resident representation IS the spill format, so
+    restores are bit-exact and byte-identity is a hard invariant even
+    through spill/restore cycles."""
+    reqs, waves = _spill_restore_trace()
+    kw = dict(max_slots=1, n_blocks=9, kv_dtype="int8")
+    off, st_off = _off_baseline("seed1-int8", params, reqs, waves, **kw)
+    host, st_host = _run(params, reqs, "host", waves, **kw)
+    assert off == host
+    assert st_off["kv_tier"] == "off" and st_host["kv_tier"] == "host"
+    assert st_host["kv_spill_blocks"] > 0
+    assert st_host["kv_restore_hits"] >= 3  # the 24-token shared prefix
+    assert st_host["kv_restore_fallbacks"] == 0
+    assert st_host["kv_restore_hit_rate"] == 1.0
+    assert st_host["recompute_tokens_saved"] == (
+        st_host["kv_restore_hits"] * 8
+    )
+    assert st_off["kv_spill_blocks"] == 0 and st_off["kv_tier_entries"] == 0
+
+
+def test_float_pool_restore_identical_on_tie_free_trace(params):
+    """Float (bf16) pool: restores dequantize int8 payloads, carrying
+    the documented ~0.5% noise — greedy near-ties CAN flip, so exact
+    equality holds only on tie-free trajectories. This trace is pinned
+    tie-free for TINY at these lengths (same caveat-and-precedent as the
+    preemption equivalence tests)."""
+    reqs, waves = _spill_restore_trace(tail=(7, 7))
+    kw = dict(max_slots=1, n_blocks=9)
+    off, _ = _run(params, reqs, "off", waves, **kw)
+    host, st = _run(params, reqs, "host", waves, **kw)
+    assert off == host
+    assert st["kv_restore_hits"] == 3
+
+
+def test_disk_tier_streams_identical(params, tmp_path):
+    """int8 pool (bit-exact restores) so equality is hard through the
+    disk level too; shares the tier-off baseline with the host test."""
+    reqs, waves = _spill_restore_trace()
+    kw = dict(max_slots=1, n_blocks=9, kv_dtype="int8")
+    off, _ = _off_baseline("seed1-int8", params, reqs, waves, **kw)
+    disk, st = _run(
+        params, reqs, "host+disk", waves,
+        kv_tier_bytes=4096, kv_tier_dir=str(tmp_path), **kw
+    )
+    assert off == disk
+    assert st["kv_restore_hits"] >= 1
+    # the tiny RAM budget forced traffic through the disk level
+    assert st["kv_spill_bytes"] > 4096
+
+
+@pytest.mark.parametrize(
+    "trial",
+    # one trial in tier-1; the rest ride the slow lane (each trial costs
+    # two engine builds' worth of XLA compiles on a 1-core CI box)
+    [0, pytest.param(1, marks=pytest.mark.slow),
+     pytest.param(2, marks=pytest.mark.slow)],
+)
+def test_randomized_traces_tier_invariant(params, trial):
+    """Randomized admit/length/sampling matrix under pool pressure:
+    byte-identical streams off vs host, preemption included (greedy and
+    seeded-sampled requests). int8 KV pool so restores are bit-exact —
+    equality is a hard invariant, not a tie-free-trace property.
+
+    dispatch_depth=1 pins the schedule: a SAMPLED request's stream is
+    not schedule-invariant across preemption in the overlapped loop
+    (where the preemption point varies with drain timing) — a
+    tier-independent property, observed off-vs-off with the tier never
+    constructed. The serial loop makes both arms deterministic, so this
+    asserts exactly the tier's contribution: zero."""
+    rng = np.random.default_rng(7 + trial)
+    reqs = []
+    for i in range(5):
+        n = int(rng.integers(6, 24))
+        r = dict(
+            prompt_ids=[int(t) for t in rng.integers(2, 200, size=n)],
+            max_new_tokens=int(rng.integers(4, 20)),
+        )
+        if i % 2:
+            r.update(temperature=0.8, seed=trial * 10 + i, top_k=8)
+        reqs.append(r)
+    kw = dict(kv_dtype="int8", dispatch_depth=1)
+    off, _ = _run(params, reqs, "off", **kw)
+    host, _ = _run(params, reqs, "host", **kw)
+    assert off == host, f"trial {trial} diverged"
+
+
+@pytest.mark.slow
+def test_preemption_resume_restores_spilled_chain(params):
+    """Tight pool + long decodes force preemption; the preempted chain
+    spills and the resumed request's streams still match tier-off.
+    Greedy requests + int8 pool: resume-by-restore is bit-exact, so
+    equality holds under the overlapped loop's timing-dependent
+    preemption points (sampled requests would not — see the
+    dispatch_depth=1 note on the randomized matrix)."""
+    rng = np.random.default_rng(2)
+    reqs = [
+        dict(
+            prompt_ids=[int(t) for t in rng.integers(2, 200, size=16)],
+            max_new_tokens=24,
+        )
+        for _ in range(5)
+    ]
+    kw = dict(dispatch_depth=2, kv_dtype="int8")
+    off, st_off = _run(params, reqs, "off", **kw)
+    host, st_host = _run(params, reqs, "host", **kw)
+    assert off == host
+    assert st_off["requests_preempted"] > 0
+    assert st_host["kv_spill_blocks"] > 0
+
+
+@pytest.mark.slow
+def test_speculative_interleave_tier_invariant(params):
+    """Greedy speculative decoding (draft+verify through the window)
+    with the tier on stays byte-identical to tier-off."""
+    rng = np.random.default_rng(5)
+    reqs = [
+        dict(
+            prompt_ids=[int(t) for t in rng.integers(2, 200, size=12)],
+            max_new_tokens=16,
+        )
+        for _ in range(4)
+    ]
+    kw = dict(
+        draft_params=params, draft_cfg=CFG, spec_k=3, dispatch_depth=2,
+    )
+    off, _ = _run(params, reqs, "off", **kw)
+    host, st = _run(params, reqs, "host", **kw)
+    assert off == host
+    assert st["spec_rounds"] > 0
+
+
+def test_unpressured_pool_never_touches_tier(params):
+    """With no pool pressure the tier must be byte-inert: zero spills,
+    zero restores, identical streams."""
+    reqs = [
+        dict(prompt_ids=[5, 1, 4, 9], max_new_tokens=8),
+        dict(prompt_ids=[2, 3], max_new_tokens=8),
+    ]
+    kw = dict(n_blocks=32)
+    off, _ = _run(params, reqs, "off", **kw)
+    host, st = _run(params, reqs, "host", **kw)
+    assert off == host
+    assert st["kv_spill_blocks"] == 0 and st["kv_restore_hits"] == 0
+    assert st["kv_tier_resident_bytes"] == 0
+
+
+# -- stats goldens (satellite 2) -------------------------------------------
+def test_prefix_hit_token_goldens(params):
+    """Hand-computed: two identical 16-token prompts, block_size 8.
+    The second request matches one full block (the cap leaves the last
+    prompt token to prefill), so prefix_hit_blocks=1, and
+    prefix_hit_tokens = 1 * 8. Nothing restored -> saved stays 0."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+    reqs = [
+        dict(prompt_ids=prompt, max_new_tokens=4),
+        dict(prompt_ids=prompt, max_new_tokens=4),
+    ]
+    waves = [(0, 1), (1, 2)]
+    _, st = _run(params, reqs, "host", waves, n_blocks=32)
+    assert st["prefix_hit_blocks"] == 1
+    assert st["prefix_hit_tokens"] == 8
+    assert st["recompute_tokens_saved"] == 0
+    assert st["kv_restore_hit_rate"] == 0.0
+
+
+def test_restore_golden_saved_tokens(params):
+    """Hand-computed restore golden: the 24-token shared prefix spills
+    as 3 full blocks; the re-hit restores all 3 -> hit_tokens = 24 (3
+    restored blocks, 0 resident matches) and saved = 24."""
+    reqs, waves = _spill_restore_trace()
+    _, st = _run(params, reqs, "host", waves, max_slots=1, n_blocks=9)
+    assert st["kv_restore_hits"] == 3
+    assert st["recompute_tokens_saved"] == 24
+    assert st["prefix_hit_tokens"] >= 24
+
+
+# -- _pop_block bookkeeping invariant (satellite 6) ------------------------
+def test_pop_block_zeroes_block_refs_bookkeeping(params):
+    """Evicted blocks must leave ``_block_refs`` with zero references —
+    a stale nonzero entry means a table still points at a recycled
+    block (the ``_pop_block`` assert). After a pressure trace with
+    spill/restore churn no free block carries a reference."""
+    reqs, waves = _spill_restore_trace(seed=3)
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, block_size=8, n_blocks=9,
+        prefill_chunk=8, chunk_max=4, kv_tier="host",
+    ).start()
+    try:
+        for lo, hi in waves:
+            hs = [engine.submit(**r) for r in reqs[lo:hi]]
+            for h in hs:
+                h.result(timeout=600)
+        for b in engine._free_blocks:
+            assert engine._block_refs.get(b, 0) == 0, (
+                f"stale refs for free block {b}"
+            )
+        for b, refs in engine._block_refs.items():
+            assert refs >= 0
+    finally:
+        engine.stop()
+
+
+# -- chaos: degradation ladder (satellite 3) -------------------------------
+@pytest.mark.chaos
+def test_chaos_restore_failure_degrades_to_recompute(params):
+    """Kill the host tier mid-flight: every restore attempt raises. The
+    engine must fall back to recompute-prefill, count the fallbacks,
+    prune the dead chain, and stream byte-identically."""
+    reqs, waves = _spill_restore_trace()
+    kw = dict(max_slots=1, n_blocks=9)
+    off, _ = _off_baseline("seed1-float", params, reqs, waves, **kw)
+
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, block_size=8, n_blocks=9,
+        prefill_chunk=8, chunk_max=4, kv_tier="host",
+    ).start()
+    outs = []
+    try:
+
+        def flaky_get(digest):
+            raise OSError("injected host-tier failure")
+
+        for i, (lo, hi) in enumerate(waves):
+            if i == len(waves) - 1:  # the restore wave
+                engine._kv_tier.get = flaky_get
+            hs = [engine.submit(**r) for r in reqs[lo:hi]]
+            outs.extend(h.result(timeout=600) for h in hs)
+        st = engine.stats()
+    finally:
+        engine.stop()
+    assert outs == off
+    assert st["kv_restore_fallbacks"] >= 1
+    assert st["kv_restore_hits"] == 0
+    assert st["kv_restore_hit_rate"] == 0.0
+    # the failed chain was pruned: no dangling spilled nodes promising
+    # restores the tier can no longer honor
+    assert st["kv_tier_spilled_nodes"] == engine._prefix_cache.spilled_count()
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_spilled_block_never_scattered(params):
+    """Flip bits in every spilled payload: the checksum re-verify must
+    drop them all (corrupt_dropped counts), restores fall back to
+    recompute, and the stream stays byte-identical — corrupted K/V is
+    never scattered into the pool."""
+    reqs, waves = _spill_restore_trace()
+    kw = dict(max_slots=1, n_blocks=9)
+    off, _ = _off_baseline("seed1-float", params, reqs, waves, **kw)
+
+    engine = InferenceEngine(
+        params, CFG, max_slots=1, max_len=64, block_size=8, n_blocks=9,
+        prefill_chunk=8, chunk_max=4, kv_tier="host",
+    ).start()
+    outs = []
+    try:
+        for i, (lo, hi) in enumerate(waves):
+            if i == len(waves) - 1:
+                tier = engine._kv_tier
+                assert len(tier._ram) > 0
+                for d, (payload, checksum) in list(tier._ram.items()):
+                    bad = bytearray(payload)
+                    bad[len(bad) // 2] ^= 0xFF
+                    tier._ram[d] = (bytes(bad), checksum)
+            hs = [engine.submit(**r) for r in reqs[lo:hi]]
+            outs.extend(h.result(timeout=600) for h in hs)
+        st = engine.stats()
+        tier_stats = engine._kv_tier.stats()
+    finally:
+        engine.stop()
+    assert outs == off
+    assert st["kv_restore_hits"] == 0
+    assert st["kv_restore_fallbacks"] >= 1
+    assert tier_stats["corrupt_dropped"] >= 1
