@@ -1044,6 +1044,16 @@ def main() -> int:
         res = bench_serving()
         print("SERVING_RESULT " + json.dumps(res), flush=True)
         return 0
+    # --out BENCH_rNN.json persists the same flat dict that goes to stdout
+    # (scripts/bench_compare.py diffs two of these across rounds; it also
+    # understands the driver's {"parsed": {...}} wrapper files)
+    out_path = None
+    if "--out" in sys.argv:
+        try:
+            out_path = sys.argv[sys.argv.index("--out") + 1]
+        except IndexError:
+            log("[bench] --out requires a path argument")
+            return 2
     notes: list[str] = []
     hb(f"bench start (total budget {TOTAL_BUDGET_S:.0f}s)")
     try:
@@ -1232,6 +1242,11 @@ def main() -> int:
     }
     hb(f"bench done (status={status})")
     print(json.dumps(result))
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=1)
+            fh.write("\n")
+        log(f"[bench] wrote {out_path}")
     return 0
 
 

@@ -545,6 +545,63 @@ def cmd_status(args) -> int:
     return 0
 
 
+# -- profile ----------------------------------------------------------------
+def cmd_profile(args) -> int:
+    """``profile serving``: ask a running inference server to record its
+    engine timeline for N seconds (/debug/trace?seconds=N on
+    examples/llama-inference/serve.py) and save the Chrome-trace JSON —
+    load it in chrome://tracing or Perfetto to see device decode chunks
+    overlapping host scheduling (docs/observability.md)."""
+    import json as _json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    url = args.url.rstrip("/")
+    seconds = args.seconds
+    if not 0 < seconds <= 60:
+        log.error("--seconds must be in (0, 60], got %s", seconds)
+        return 1
+    qs = urllib.parse.urlencode({"seconds": seconds})
+    log.info("recording %ss of engine timeline from %s ...", seconds, url)
+    try:
+        # the server blocks for the full capture window before replying,
+        # so the client timeout must comfortably exceed --seconds
+        with urllib.request.urlopen(
+            f"{url}/debug/trace?{qs}", timeout=seconds + 30
+        ) as resp:
+            trace = _json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        log.error("no serving endpoint at %s: %s", url, e)
+        return 1
+    if "error" in trace:
+        log.error("server rejected the capture: %s", trace["error"])
+        return 1
+    events = trace.get("traceEvents") or []
+    lanes = sorted(
+        {
+            e["args"]["name"]
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+    )
+    with open(args.out, "w", encoding="utf-8") as fh:
+        _json.dump(trace, fh)
+    meta = trace.get("metadata") or {}
+    log.done(
+        "wrote %s (%d events, %d dropped) — open in chrome://tracing",
+        args.out,
+        meta.get("events", sum(1 for e in events if e.get("ph") == "X")),
+        meta.get("dropped", 0),
+    )
+    if lanes:
+        log.info("lanes: %s", ", ".join(lanes))
+    return 0
+
+
 # -- config mutation (add/remove) ------------------------------------------
 def _load_for_edit(args) -> tuple[Context, latest.Config]:
     ctx = Context(args)
@@ -1588,6 +1645,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="(serving) base URL of a running inference server",
     )
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "profile", help="capture an engine timeline from a running server"
+    )
+    sp.add_argument(
+        "what",
+        choices=["serving"],
+        help="what to profile (serving: the inference engine timeline)",
+    )
+    sp.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="base URL of a running inference server",
+    )
+    sp.add_argument(
+        "--seconds",
+        type=float,
+        default=2.0,
+        help="capture window in seconds (0 < N <= 60)",
+    )
+    sp.add_argument(
+        "--out",
+        default="serving-timeline.json",
+        help="destination for the Chrome-trace JSON",
+    )
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("add", help="add config entries")
     add_sub = sp.add_subparsers(dest="kind", required=True)

@@ -60,14 +60,19 @@ that fact:
   read from a poisoned future.
 
 Per-slot stream equivalence (why depth does not change outputs): the
-decode kernel advances a slot's PRNG key and samples once per scan step
-*in which the slot is active*, attention reads only the slot's own
-blocks, and the slot's carry row chains device-side from its prefill
-seed. A slot's n-th emitted token is therefore a function of (prompt,
-seed, resume fold-in, n) only — independent of chunk sizes, co-resident
-membership, and window depth. The pinned suite
-(tests/test_engine_dispatch.py) asserts byte-identical streams between
-depth 1 and depth 2 across randomized admit/EOS/preemption traces.
+decode kernel holds each slot's BASE PRNG key (``PRNGKey(seed)``, never
+advanced) and derives the sample key for the token at position p+1 as
+``fold_in(base, p)`` — a pure function of the token's absolute
+position. Attention reads only the slot's own blocks, and the slot's
+carry row chains device-side from its prefill seed, so a slot's n-th
+emitted token is a function of (prompt, seed, n) only — independent of
+chunk sizes, co-resident membership, window depth, AND preemption
+points: a preempted-and-resumed request re-derives the identical key
+for committed token k regardless of where mid-chunk the preemption
+landed (ROADMAP item 2, schedule-invariant sampled streams). The
+pinned suite (tests/test_engine_dispatch.py) asserts byte-identical
+streams between depth 1 and depth 2 across randomized admit/EOS/
+preemption traces, greedy and sampled.
 """
 
 from __future__ import annotations
@@ -79,6 +84,8 @@ from typing import TYPE_CHECKING, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..obs.tracing import TRACK_READBACK, device_decode_track
 
 if TYPE_CHECKING:  # pragma: no cover — import cycle guard, types only
     from .engine import InferenceEngine
@@ -101,13 +108,19 @@ def _toks_ready(toks) -> bool:
 class _InFlight:
     """One dispatched-but-unread decode chunk."""
 
-    __slots__ = ("toks", "slots", "gens", "k_steps")
+    __slots__ = ("toks", "slots", "gens", "k_steps", "t_dispatch", "lane")
 
     def __init__(self, toks, slots: list[int], gens: list[int], k_steps: int):
         self.toks = toks  # [k_steps, B] device future
         self.slots = slots  # participating slot indices
         self.gens = gens  # slot.gen at dispatch (re-admission guard)
         self.k_steps = k_steps
+        # timeline capture only: dispatch timestamp + window lane, so the
+        # profiler can draw the chunk's device residency [dispatch,
+        # readback] on a per-lane track and overlapping chunks render
+        # side by side instead of stacking on one bar
+        self.t_dispatch = 0.0
+        self.lane = 0
 
 
 class DecodeDispatcher:
@@ -254,11 +267,13 @@ class DecodeDispatcher:
             eng._min_until,
             eng._logit_bias,
         )
-        self.window.append(
-            _InFlight(
-                toks, list(plain), [eng.slots[i].gen for i in plain], k_steps
-            )
+        entry = _InFlight(
+            toks, list(plain), [eng.slots[i].gen for i in plain], k_steps
         )
+        if eng._timeline is not None:
+            entry.t_dispatch = time.monotonic()
+            entry.lane = self.dispatches % self.depth
+        self.window.append(entry)
         for i in plain:
             self.refs[i] += 1
             self.inflight_steps[i] += k_steps
@@ -295,8 +310,30 @@ class DecodeDispatcher:
         try:
             toks = np.asarray(jax.device_get(entry.toks))
         finally:
-            self.readback_wait_s += time.monotonic() - t0
+            t1 = time.monotonic()
+            self.readback_wait_s += t1 - t0
         eng = self.engine
+        tl = eng._timeline
+        if tl is not None and entry.t_dispatch:
+            # device residency [dispatch, readback-complete] on the
+            # chunk's window lane; the host-side blocked wait separately
+            traces = [
+                getattr(eng.slots[i].req, "_obs_trace", None)
+                for i, g in zip(entry.slots, entry.gens)
+                if eng.slots[i].req is not None and eng.slots[i].gen == g
+            ]
+            tl.add(
+                device_decode_track(entry.lane),
+                f"decode x{entry.k_steps}",
+                entry.t_dispatch,
+                t1,
+                slots=list(entry.slots),
+                k_steps=entry.k_steps,
+                trace_ids=[
+                    t.trace_id for t in traces if t is not None
+                ],
+            )
+            tl.add(TRACK_READBACK, "device_get", t0, t1)
         for n, i in enumerate(entry.slots):
             self.refs[i] -= 1
             self.inflight_steps[i] -= entry.k_steps

@@ -63,6 +63,13 @@ import numpy as np
 from ..models import transformer as tfm
 from ..obs.metrics import Registry, WindowedRate, metrics_enabled
 from ..obs.request_trace import ServingTelemetry
+from ..obs.tracing import (
+    TRACK_HOST_SCHED,
+    TRACK_PREFILL,
+    TRACK_SPEC,
+    TRACK_TIER_RESTORE,
+    TimelineRecorder,
+)
 from .dispatch import DecodeDispatcher, resolve_dispatch_depth
 from .kv_tier import (
     HostKVTier,
@@ -207,6 +214,10 @@ class Request:
     # slices to this length; ``tokens`` itself is never shrunk because a
     # stream() consumer in another thread may be mid-iteration over it
     result_len: Optional[int] = None
+    # inbound W3C traceparent header (distributed tracing, ISSUE 8):
+    # parsed by ServingTelemetry.on_submit so the request's lifecycle
+    # trace joins the caller's trace instead of rooting a fresh one
+    traceparent: Optional[str] = None
     # filled by the engine
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -629,6 +640,12 @@ class InferenceEngine:
         if metrics_enabled(metrics):
             self.telemetry = ServingTelemetry(metrics_registry)
             self._register_metric_families()
+        # on-demand timeline profiler (ISSUE 8): None except during a
+        # capture window (start_timeline / /debug/trace). Every hook on
+        # the scheduler path is a single ``is None`` check when off; on,
+        # the loop/dispatcher/tier stream events onto named Chrome-trace
+        # lanes so the overlapped dispatcher's concurrency is visible.
+        self._timeline: Optional[TimelineRecorder] = None
         self._stop = threading.Event()
         # serializes submit's check+put against stop's set+drain, closing
         # the window where a request lands in the queue after the drain
@@ -691,14 +708,15 @@ class InferenceEngine:
                     vocab_iota == eos_ids[:, None]
                 )
                 logits = jnp.where(suppress, -jnp.inf, logits)
-                split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                subs = split[:, 1]
-                # a slot's key advances once per step IT decodes, never
-                # during peers' chunks — its sampled stream is then a
-                # function of (seed, own step count) only, independent of
-                # co-resident membership and dispatch-window depth (the
-                # equivalence the overlapped loop is pinned to)
-                keys = jnp.where(active[:, None], split[:, 0], keys)
+                # keys holds each slot's BASE key (PRNGKey(seed)), never
+                # advanced: the sample key for the token written at
+                # position pos+1 is fold_in(base, pos), a pure function
+                # of the token's absolute position. The stream is then
+                # invariant to co-resident membership, dispatch-window
+                # depth, AND preemption points — a resumed request
+                # re-derives the same key for committed token k no matter
+                # where mid-chunk the preemption landed (ROADMAP item 2).
+                subs = jax.vmap(jax.random.fold_in)(keys, pos)
                 if use_filters:
                     tok = jax.vmap(sample_logits)(
                         subs, logits, temps, top_ks, top_ps
@@ -1041,6 +1059,7 @@ class InferenceEngine:
         stop: Optional[list[list[int]]] = None,
         min_new_tokens: int = 0,
         logit_bias: Optional[dict[int, float]] = None,
+        traceparent: Optional[str] = None,
     ) -> Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -1077,6 +1096,7 @@ class InferenceEngine:
             stop=stop,
             min_new_tokens=int(min_new_tokens),
             logit_bias=logit_bias,
+            traceparent=traceparent,
         )
         # trace BEFORE the queue put: the scheduler may admit the request
         # the instant it lands, and on_admit is a no-op without the trace
@@ -1199,7 +1219,9 @@ class InferenceEngine:
                 timings[f"draft_prefill_{c}"] = round(time.monotonic() - t0, 3)
             for filt, fn in self._spec_round_jit.items():
                 t0 = time.monotonic()
-                self.pool, self._draft_cache, self._keys, _, _ = fn(
+                # keys output discarded: self._keys holds base keys that
+                # never advance (_run_spec_round derives per-round keys)
+                self.pool, self._draft_cache, _, _, _ = fn(
                     self.params,
                     self.draft_params,
                     self.pool,
@@ -1298,6 +1320,39 @@ class InferenceEngine:
         counters + request-latency histograms); "" when disabled."""
         reg = self.metrics_registry
         return reg.render() if reg is not None else ""
+
+    # -- timeline profiler (obs/tracing.py) --------------------------------
+    def start_timeline(self, max_events: int = 100_000) -> TimelineRecorder:
+        """Attach a timeline recorder. The scheduler loop, the decode
+        dispatcher and the KV-tier restore path stream events onto named
+        Chrome-trace lanes until :meth:`stop_timeline`. Idempotent-ish:
+        starting over an active capture replaces it."""
+        tl = TimelineRecorder(max_events=max_events)
+        if self._kv_tier is not None:
+            from ..obs.tracing import get_tracer
+
+            self._kv_tier.tracer = get_tracer()
+        self._timeline = tl
+        return tl
+
+    def stop_timeline(self) -> Optional[TimelineRecorder]:
+        """Detach and return the active recorder (None if none)."""
+        tl = self._timeline
+        self._timeline = None
+        if self._kv_tier is not None:
+            self._kv_tier.tracer = None
+        return tl
+
+    def capture_timeline(
+        self, seconds: float, max_events: int = 100_000
+    ) -> dict:
+        """Blocking convenience for ``/debug/trace?seconds=N``: record
+        for ``seconds`` wall time, then render Chrome-trace JSON. Runs
+        on the caller's thread (an HTTP handler), not the scheduler."""
+        self.start_timeline(max_events=max_events)
+        time.sleep(max(0.0, float(seconds)))
+        tl = self.stop_timeline()
+        return tl.chrome() if tl is not None else {"traceEvents": []}
 
     def _register_metric_families(self) -> None:
         """Register ENGINE_METRIC_FAMILIES as pull-style callbacks over
@@ -1603,8 +1658,27 @@ class InferenceEngine:
         restored = len(blks)
         self._dispatcher.note_restores(restored, overlapped)
         self._dispatcher.invalidate_table(slot_idx)
+        now = time.monotonic()
         if self._kv_restore_hist is not None:
-            self._kv_restore_hist.observe(time.monotonic() - t0)
+            self._kv_restore_hist.observe(now - t0)
+        # distributed trace + timeline: the restore belongs to the slot's
+        # request (cold path — runs once per admission with a tier hit)
+        req = self.slots[slot_idx].req
+        trace = getattr(req, "_obs_trace", None) if req is not None else None
+        if trace is not None:
+            trace.event(f"kv_restore:{restored}", now)
+        tl = self._timeline
+        if tl is not None:
+            tl.add(
+                TRACK_TIER_RESTORE,
+                f"restore x{restored}",
+                t0,
+                now,
+                slot=slot_idx,
+                blocks=restored,
+                overlapped=overlapped,
+                trace_id=trace.trace_id if trace is not None else None,
+            )
         return restored
 
     def _publish_prefix_blocks(self, slot_idx: int) -> None:
@@ -1898,6 +1972,8 @@ class InferenceEngine:
         real = min(remaining, c)
         chunk = slot.prompt[offset : offset + real] + [0] * (c - real)
         table = jnp.asarray(self._tables[slot_idx])
+        tl = self._timeline
+        t_pf = time.monotonic() if tl is not None else 0.0
         logits, self.pool = self._prefill_step_jit(
             self.params,
             self.pool,
@@ -1905,18 +1981,33 @@ class InferenceEngine:
             jnp.asarray(chunk, jnp.int32),
             jnp.asarray(offset, jnp.int32),
         )
+        if tl is not None:
+            trace = getattr(req, "_obs_trace", None)
+            tl.add(
+                TRACK_PREFILL,
+                f"prefill slot {slot_idx} @{offset}+{real}",
+                t_pf,
+                time.monotonic(),
+                slot=slot_idx,
+                offset=offset,
+                tokens=real,
+                trace_id=trace.trace_id if trace is not None else None,
+            )
         slot.prefill_pos = offset + real
         self._publish_prefix_blocks(slot_idx)
         if self.telemetry is not None:
             self.telemetry.on_prefill_chunk(req, slot.prefill_pos)
         if slot.prefill_pos >= t:
-            # prefill complete: first token from the last REAL position
+            # prefill complete: first token from the last REAL position.
+            # The slot's device row holds the BASE key; every sample key
+            # is fold_in(base, position of the token sampled FROM). Here
+            # that position is len(slot.prompt)-1 — for a fresh request
+            # that's the last prompt token, and on preemption resume
+            # (slot.prompt = prompt_ids + generated) it's the last
+            # pre-preemption token, so the resumed stream re-derives
+            # exactly the keys the uninterrupted run would have used.
             key = jax.random.PRNGKey(req.seed)
-            if req.tokens:
-                # preemption resume: don't replay the key sequence the
-                # pre-preemption prefix already consumed
-                key = jax.random.fold_in(key, len(req.tokens))
-            key, sub = jax.random.split(key)
+            sub = jax.random.fold_in(key, len(slot.prompt) - 1)
             self._keys = self._keys.at[slot_idx].set(key)
             lg = logits[real - 1]
             # the first generated token samples host-side, so the
@@ -2200,6 +2291,17 @@ class InferenceEngine:
         self._reset_pool()  # donated buffer is gone
         self._reset_draft_cache()
 
+    def _note_iter(self, t_iter: float) -> None:
+        """Close out one scheduler iteration: account busy time and, when
+        a timeline capture is live, put the iteration on the host-sched
+        lane (the async dispatch inside it overlaps the device lanes —
+        that overlap is exactly what the profiler exists to show)."""
+        now = time.monotonic()
+        self._dispatcher.loop_busy_s += now - t_iter
+        tl = self._timeline
+        if tl is not None:
+            tl.add(TRACK_HOST_SCHED, "iteration", t_iter, now)
+
     def _loop(self) -> None:
         """Scheduler iterations: admission, ONE bounded prefill chunk,
         spec-round interleaving, chunk sizing + block coverage (with the
@@ -2228,7 +2330,7 @@ class InferenceEngine:
                         d.drain(block=True)
                     except Exception as e:  # noqa: BLE001
                         self._dispatch_failed(e)
-                    d.loop_busy_s += time.monotonic() - t_iter
+                    self._note_iter(t_iter)
                     continue
                 # idle: wait for work
                 try:
@@ -2265,7 +2367,7 @@ class InferenceEngine:
                         d.drain(block=False)
                     except Exception as e:  # noqa: BLE001
                         self._dispatch_failed(e)
-                    d.loop_busy_s += time.monotonic() - t_iter
+                    self._note_iter(t_iter)
                     continue
             if not ready:
                 continue
@@ -2283,7 +2385,7 @@ class InferenceEngine:
                     d.drain_all()
                 except Exception as e:  # noqa: BLE001
                     self._dispatch_failed(e)
-                    d.loop_busy_s += time.monotonic() - t_iter
+                    self._note_iter(t_iter)
                     continue
                 ready = [
                     i
@@ -2376,7 +2478,7 @@ class InferenceEngine:
                 if s.req is None:  # got preempted itself
                     ready.remove(i)
             if restart:
-                d.loop_busy_s += time.monotonic() - t_iter
+                self._note_iter(t_iter)
                 continue
             # liveness re-filter for BOTH groups: _preempt_youngest picks
             # by admitted_at, not index order, so a victim whose own
@@ -2423,7 +2525,7 @@ class InferenceEngine:
                 d.drain(block=d.full or not plain)
             except Exception as e:  # noqa: BLE001 — device errors (OOM, …)
                 self._dispatch_failed(e)
-            d.loop_busy_s += time.monotonic() - t_iter
+            self._note_iter(t_iter)
 
     def _run_spec_round(self, spec_idx: list[int]) -> None:
         """One speculative round for ``spec_idx`` slots (others parked):
@@ -2485,11 +2587,20 @@ class InferenceEngine:
             )
             for i in spec_idx
         )
+        tl = self._timeline
+        t_spec = time.monotonic() if tl is not None else 0.0
         try:
+            # self._keys holds per-slot BASE keys (never advanced — see
+            # decode_chunk): anchor this round's split chain at the
+            # verify position so a replayed round re-derives the same
+            # chain, and discard the advanced keys the jit returns
+            round_keys = jax.vmap(jax.random.fold_in)(
+                self._keys, pos0_verify
+            )
             (
                 self.pool,
                 self._draft_cache,
-                self._keys,
+                _,
                 commit,
                 n_commit,
             ) = self._spec_round_jit[filters_on](
@@ -2501,7 +2612,7 @@ class InferenceEngine:
                 cur,
                 pos0_draft,
                 pos0_verify,
-                self._keys,
+                round_keys,
                 temps,
                 top_ks,
                 top_ps,
@@ -2519,6 +2630,29 @@ class InferenceEngine:
             self._reset_pool()
             self._reset_draft_cache()
             return
+        if tl is not None:
+            # one bar per draft/verify dispatch: with speculation on,
+            # this IS the device-decode work (plain chunks never run for
+            # these slots), so without it the profiler would show a
+            # silent device under greedy spec traffic
+            tl.add(
+                TRACK_SPEC,
+                f"spec round x{len(spec_idx)}",
+                t_spec,
+                time.monotonic(),
+                slots=list(spec_idx),
+                spec_k=self.spec_k,
+                spec_depth=self.spec_depth,
+                trace_ids=[
+                    t.trace_id
+                    for t in (
+                        getattr(self.slots[i].req, "_obs_trace", None)
+                        for i in spec_idx
+                        if self.slots[i].req is not None
+                    )
+                    if t is not None
+                ],
+            )
         k = self.spec_k
         for i in spec_idx:
             for r in range(self.spec_depth):

@@ -126,6 +126,12 @@ class HostKVTier:
         # with no disk level, or aged off disk) — the engine prunes the
         # matching spilled radix node so matches never dangle
         self.on_evict: Optional[Callable[[str], None]] = None
+        # optional span sink (obs/tracing.Tracer): attached by the engine
+        # for the duration of a timeline capture so spill/restore I/O
+        # shows up as real spans (digest, bytes, outcome) under whatever
+        # trace context is active on the scheduler thread. None keeps
+        # put/get at one attribute check of overhead.
+        self.tracer = None
         self.puts = 0
         self.hits = 0
         self.misses = 0
@@ -198,6 +204,15 @@ class HostKVTier:
         """Retain one spilled block. Re-putting an existing digest
         refreshes its LRU position (the payload is content-addressed —
         equal digests mean equal bytes, so the old copy is kept)."""
+        if self.tracer is not None:
+            with self.tracer.span(
+                "kv_tier.put", digest=digest[:16], bytes=len(payload)
+            ):
+                self._put(digest, payload)
+            return
+        self._put(digest, payload)
+
+    def _put(self, digest: str, payload: bytes) -> None:
         self.puts += 1
         if digest in self._ram:
             self._ram.move_to_end(digest)
@@ -214,6 +229,16 @@ class HostKVTier:
         re-verified on EVERY read; a checksum mismatch drops the entry
         and reports a miss — corrupted K/V is never handed back to be
         scattered into the pool."""
+        if self.tracer is not None:
+            with self.tracer.span(
+                "kv_tier.get", digest=digest[:16]
+            ) as sp:
+                payload = self._get(digest)
+                sp.attrs["hit"] = payload is not None
+            return payload
+        return self._get(digest)
+
+    def _get(self, digest: str) -> Optional[bytes]:
         entry = self._ram.get(digest)
         if entry is not None:
             payload, checksum = entry

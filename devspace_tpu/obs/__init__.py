@@ -27,8 +27,26 @@ from .request_trace import (
     RequestTrace,
     ServingTelemetry,
 )
+from .tracing import (
+    TIMELINE_TRACKS,
+    TRACING_METRIC_FAMILIES,
+    Span,
+    SpanContext,
+    TimelineRecorder,
+    Tracer,
+    current_traceparent,
+    get_tracer,
+)
 
 __all__ = [
+    "TIMELINE_TRACKS",
+    "TRACING_METRIC_FAMILIES",
+    "Span",
+    "SpanContext",
+    "TimelineRecorder",
+    "Tracer",
+    "current_traceparent",
+    "get_tracer",
     "DEFAULT_LATENCY_BUCKETS",
     "Counter",
     "Gauge",

@@ -147,8 +147,15 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (value, trace_id, wall_ts); last writer wins
+        self._exemplars: dict[int, tuple[float, str, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        """Record ``v``. ``exemplar`` (a trace_id) attaches an OpenMetrics
+        exemplar to the bucket the observation lands in — last writer
+        wins per bucket — linking e.g. a p99 TTFT bucket straight to the
+        distributed trace that produced it (``render_openmetrics``).
+        Without an exemplar the hot path is unchanged."""
         v = float(v)
         i = 0
         for i, edge in enumerate(self.buckets):  # noqa: B007
@@ -160,19 +167,25 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar:
+                self._exemplars[i] = (v, str(exemplar), time.time())
 
     def snapshot(self) -> dict:
-        """``{"buckets": [(le, cumulative_count)...], "sum": s, "count": n}``
-        with the implicit +Inf bucket last."""
+        """``{"buckets": [(le, cumulative_count)...], "sum": s, "count": n,
+        "exemplars": [...]}`` with the implicit +Inf bucket last;
+        ``exemplars`` aligns with ``buckets`` — ``(value, trace_id,
+        wall_ts)`` or None per bucket."""
         with self._lock:
             counts = list(self._counts)
             s, n = self._sum, self._count
+            ex = dict(self._exemplars)
         out, cum = [], 0
         for edge, c in zip(self.buckets, counts):
             cum += c
             out.append((edge, cum))
         out.append((math.inf, n))
-        return {"buckets": out, "sum": s, "count": n}
+        exemplars = [ex.get(i) for i in range(len(self.buckets) + 1)]
+        return {"buckets": out, "sum": s, "count": n, "exemplars": exemplars}
 
     @property
     def sum(self) -> float:
@@ -353,6 +366,51 @@ class Registry:
                 else:
                     lines.append(f"{name}{_label_str(labels)} {_fmt(val)}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition — same families as
+        :meth:`render` plus histogram bucket exemplars
+        (``... # {trace_id="..."} value ts``), which is the one thing
+        the 0.0.4 format cannot carry. Served on ``/metrics`` content
+        negotiation by the serving example."""
+        lines: list[str] = []
+        for name, fam in sorted(self.snapshot().items()):
+            kind = fam["kind"]
+            # OpenMetrics: a counter family is named WITHOUT the _total
+            # suffix; its sample keeps it.
+            fam_name = (
+                name[: -len("_total")]
+                if kind == "counter" and name.endswith("_total")
+                else name
+            )
+            lines.append(f"# TYPE {fam_name} {kind}")
+            lines.append(f"# HELP {fam_name} {_escape_help(fam['help'])}")
+            for labels, val in fam["samples"]:
+                if kind == "histogram":
+                    exemplars = val.get("exemplars") or [None] * len(
+                        val["buckets"]
+                    )
+                    for (le, cum), ex in zip(val["buckets"], exemplars):
+                        lb = dict(labels)
+                        lb["le"] = _fmt(le)
+                        line = f"{name}_bucket{_label_str(lb)} {cum}"
+                        if ex is not None:
+                            ev, tid, ts = ex
+                            line += (
+                                f' # {{trace_id="{_escape_label_value(tid)}"}}'
+                                f" {_fmt(ev)} {_fmt(ts)}"
+                            )
+                        lines.append(line)
+                    lines.append(
+                        f"{name}_sum{_label_str(labels)} {_fmt(val['sum'])}"
+                    )
+                    lines.append(
+                        f"{name}_count{_label_str(labels)} {val['count']}"
+                    )
+                else:
+                    lines.append(f"{name}{_label_str(labels)} {_fmt(val)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 class WindowedRate:

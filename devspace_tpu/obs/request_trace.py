@@ -41,6 +41,7 @@ from collections import deque
 from typing import Optional
 
 from .metrics import Registry
+from .tracing import SpanContext, derive_span_id, new_trace_id
 
 # (name, kind, help) — the lintable catalog (scripts/metrics_lint.py);
 # ServingTelemetry registers EXACTLY these so spec and registration
@@ -89,6 +90,7 @@ class RequestTrace:
         "id", "prompt_len", "max_new_tokens", "events", "t_wall_enqueue",
         "t_enqueue", "t_admit", "t_prefill_done", "t_first", "t_last",
         "n_tokens", "preemptions", "outcome",
+        "trace_id", "span_id", "parent_span_id",
     )
 
     def __init__(self, rid: int, prompt_len: int, max_new_tokens: int, now: float):
@@ -105,6 +107,11 @@ class RequestTrace:
         self.n_tokens = 0
         self.preemptions = 0
         self.outcome: Optional[str] = None
+        # distributed-trace identity (ISSUE 8): set by ServingTelemetry
+        # from the request's inbound traceparent (or freshly minted)
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.parent_span_id: Optional[str] = None
 
     def event(self, name: str, t: float) -> None:
         if len(self.events) < _MAX_EVENTS:
@@ -147,6 +154,7 @@ class RequestTrace:
 
         return {
             "id": self.id,
+            "trace_id": self.trace_id,
             "prompt_len": self.prompt_len,
             "max_new_tokens": self.max_new_tokens,
             "tokens_generated": self.n_tokens,
@@ -172,6 +180,10 @@ class RequestTrace:
 
         spans = []
 
+        root_sid = self.span_id or (
+            derive_span_id(self.trace_id or "", f"request-{self.id}")
+        )
+
         def phase(name, t0, t1, **attrs):
             if t0 is None or t1 is None:
                 return
@@ -183,6 +195,11 @@ class RequestTrace:
                     "start": wall(t0),
                     "duration_s": round(t1 - t0, 6),
                     "request_id": self.id,
+                    "trace_id": self.trace_id,
+                    # deterministic child ids: pure function of the root
+                    # span id and the phase name (golden-testable)
+                    "span_id": derive_span_id(root_sid, name),
+                    "parent_span_id": root_sid,
                     "ok": self.outcome != "failed",
                     **attrs,
                 }
@@ -202,6 +219,9 @@ class RequestTrace:
                 "start": self.t_wall_enqueue,
                 "duration_s": round(end - self.t_enqueue, 6),
                 "request_id": self.id,
+                "trace_id": self.trace_id,
+                "span_id": root_sid,
+                "parent_span_id": self.parent_span_id,
                 "outcome": self.outcome,
                 "tokens": self.n_tokens,
                 "ok": self.outcome != "failed",
@@ -248,6 +268,15 @@ class ServingTelemetry:
         trace = RequestTrace(
             next(self._ids), len(req.prompt_ids), req.max_new_tokens, now
         )
+        # join the caller's distributed trace when it sent a valid
+        # traceparent (serve.py forwards the HTTP header onto the
+        # Request); otherwise this request roots a fresh trace. The
+        # request's own span id is derived, not random, so replays and
+        # golden tests see stable ids.
+        ctx = SpanContext.from_traceparent(getattr(req, "traceparent", None))
+        trace.trace_id = ctx.trace_id if ctx else new_trace_id()
+        trace.parent_span_id = ctx.span_id if ctx else None
+        trace.span_id = derive_span_id(trace.trace_id, f"request-{trace.id}")
         trace.event("enqueue", now)
         req._obs_trace = trace
         with self._lock:
@@ -293,7 +322,8 @@ class ServingTelemetry:
         if t.t_first is None:
             t.t_first = now
             t.event("first_token", now)
-            self.ttft.observe(now - t.t_enqueue)
+            # exemplar links e.g. the p99 TTFT bucket to its trace
+            self.ttft.observe(now - t.t_enqueue, exemplar=t.trace_id)
         t.t_last = now
         t.n_tokens += 1
 
@@ -317,7 +347,7 @@ class ServingTelemetry:
         t.event(outcome, now)
         self.finished.labels(outcome=outcome).inc()
         if outcome == "completed":
-            self.e2e.observe(t.e2e_s(now))
+            self.e2e.observe(t.e2e_s(now), exemplar=t.trace_id)
             tp = t.tpot_s
             if tp is not None:
                 self.tpot.observe(tp)

@@ -112,13 +112,25 @@ class RetryPolicy:
         fires before each backoff wait. Non-matching exceptions propagate
         immediately; exhausted attempts raise :class:`RetryExhausted` —
         or, with ``reraise=True``, the last underlying exception (for call
-        sites whose callers dispatch on the original exception type)."""
+        sites whose callers dispatch on the original exception type).
+
+        Trace propagation (ISSUE 8): the caller's active span context is
+        captured once at entry and re-attached around EVERY attempt, so
+        spans opened inside attempt N > 1 — including remote-exec
+        traceparents exported after a shell revive — still parent under
+        the operation that started the retry loop, even when ``sleep`` /
+        ``on_retry`` callbacks disturbed the thread-local stack."""
+        from ..obs.tracing import get_tracer
+
+        tracer = get_tracer()
+        trace_ctx = tracer.current_context()
         start = clock()
         last: Optional[BaseException] = None
         delays = self.delays()
         for attempt in range(1, self.max_attempts + 1):
             try:
-                return fn(*args, **kwargs)
+                with tracer.attach(trace_ctx):
+                    return fn(*args, **kwargs)
             except self.retry_on as e:  # noqa: PERF203 — retry is the point
                 last = e
             try:

@@ -30,6 +30,7 @@ import queue as queue_mod
 import threading
 import time
 
+from ..obs.tracing import get_tracer
 from .shell import SyncError
 
 _SENTINEL = None
@@ -47,6 +48,35 @@ class UploadPipeline:
         live = session._live_indices()
         if not live:
             raise SyncError("sync has no live workers left")
+        # trace context captured on the producer thread; consumers
+        # re-attach it (pool threads have empty thread-local stacks), so
+        # every per-worker upload span — success, retry after revive, or
+        # the failed attempt that quarantines the worker — carries the
+        # originating operation's trace_id
+        tracer = get_tracer()
+        ctx = tracer.current_context() or getattr(
+            session, "_session_ctx", None
+        )
+
+        def upload_once(i: int, bidx: int, tar, retry: bool) -> None:
+            with tracer.attach(ctx):
+                sp = tracer.start_span(
+                    "sync.upload",
+                    attrs={"worker": i, "batch": bidx, "retry": retry},
+                )
+                try:
+                    session._upload_raw(
+                        session._shells[i], session.workers[i], tar
+                    )
+                except Exception as e:  # noqa: BLE001 — ladder decides
+                    sp.attrs["outcome"] = "failed"
+                    tracer.end_span(
+                        sp, ok=False, error=f"{type(e).__name__}: {e}"
+                    )
+                    raise
+                else:
+                    sp.attrs["outcome"] = "delivered"
+                    tracer.end_span(sp, ok=True)
         queues = {i: queue_mod.Queue(maxsize=self.depth) for i in live}
         lock = threading.Lock()
         # batch idx -> [workers still pending, deliveries ok, entries]
@@ -87,16 +117,15 @@ class UploadPipeline:
                     finish(bidx, ok=False)
                     continue
                 try:
-                    session._upload_raw(session._shells[i], session.workers[i], tar)
+                    upload_once(i, bidx, tar, retry=False)
                     finish(bidx, ok=True)
                 except Exception as e:  # noqa: BLE001 — graded ladder below
                     err = e
                     if session._try_revive(i):
                         try:
-                            # re-read the shell: revive swapped it
-                            session._upload_raw(
-                                session._shells[i], session.workers[i], tar
-                            )
+                            # re-read the shell: revive swapped it; the
+                            # retry span re-attaches the SAME context
+                            upload_once(i, bidx, tar, retry=True)
                             finish(bidx, ok=True)
                             continue
                         except Exception as e2:  # noqa: BLE001

@@ -281,6 +281,13 @@ class SyncSession:
         # Rogue paths seen on a worker last pass — removal needs two
         # consecutive sightings (see _verify_worker).
         self._extra_candidates: dict[int, set[str]] = {}
+        # distributed-trace root for this session (ISSUE 8): opened in
+        # start(), closed in stop(). Fan-out ops re-attach this context
+        # in their pool threads (thread-locals do not cross the
+        # ThreadPoolExecutor boundary), so every per-worker span — and
+        # the $TRACEPARENT the shells export remotely — parents here.
+        self._session_span = None
+        self._session_ctx = None
         _LIVE_SESSIONS.add(self)
 
     # -- paths -------------------------------------------------------------
@@ -299,6 +306,12 @@ class SyncSession:
         """Open shells, run initial sync, then start the pipes
         (reference: sync_config.go Start/mainLoop)."""
         self.started_at = time.time()
+        from ..obs.tracing import get_tracer
+
+        self._session_span = get_tracer().start_span(
+            "sync.session", attrs={"workers": len(self.workers)}, push=False
+        )
+        self._session_ctx = self._session_span.context
         self.log.info(
             "[sync] starting: %s <-> %s on %d worker(s)",
             self.opts.local_path,
@@ -321,7 +334,10 @@ class SyncSession:
         self._watcher = new_watcher(self.opts.local_path, self.upload_exclude)
         self._watcher.start()
 
-        self.initial_sync()
+        # initial sync (and its fan-out + shell traffic) parents under
+        # the session root span
+        with get_tracer().attach(self._session_ctx):
+            self.initial_sync()
         self.initial_sync_done.set()
 
         t_up = threading.Thread(target=self._upstream_loop, daemon=True, name="sync-upstream")
@@ -367,6 +383,15 @@ class SyncSession:
         if self._down_shell:
             self._down_shell.close()
         self._pool.shutdown(wait=False)
+        if self._session_span is not None:
+            from ..obs.tracing import get_tracer
+
+            get_tracer().end_span(
+                self._session_span,
+                ok=self.error is None,
+                error=str(self.error) if self.error else None,
+            )
+            self._session_span = None
 
     # -- local walk --------------------------------------------------------
     def _walk_local(self) -> dict[str, FileInformation]:
@@ -683,7 +708,24 @@ class SyncSession:
         live = self._live_indices()
         if not live:
             raise SyncError("sync has no live workers left")
-        futures = {i: self._pool.submit(op, i) for i in live}
+        # capture the caller's trace context HERE: the pool threads have
+        # their own (empty) thread-local stacks, so each per-worker op
+        # re-attaches it explicitly — its span (and the $TRACEPARENT the
+        # shell exports remotely) then parents under the operation that
+        # fanned out, not under nothing
+        from ..obs.tracing import get_tracer
+
+        tracer = get_tracer()
+        ctx = tracer.current_context() or self._session_ctx
+
+        def traced(i: int, retry: bool = False) -> None:
+            with tracer.attach(ctx):
+                with tracer.span(
+                    f"sync.{what}", worker=i, retry=retry
+                ):
+                    op(i)
+
+        futures = {i: self._pool.submit(traced, i) for i in live}
         ok: list[int] = []
         for i, f in futures.items():
             try:
@@ -693,7 +735,9 @@ class SyncSession:
                 err = e
                 if self._try_revive(i):
                     try:
-                        op(i)
+                        # retry inline, SAME context re-attached — the
+                        # retried attempt stays in the original trace
+                        traced(i, retry=True)
                         ok.append(i)
                         continue
                     except Exception as e2:  # noqa: BLE001

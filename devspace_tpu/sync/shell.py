@@ -98,12 +98,28 @@ class RemoteShell:
     def alive(self) -> bool:
         return self.proc.poll() is None
 
+    def _trace_env(self) -> str:
+        """Shell statement exporting the CALLER's active trace context as
+        ``$TRACEPARENT`` on the remote side — the W3C header is how the
+        trace crosses the exec boundary (ISSUE 8): remote tooling (or a
+        nested devspace) reads the env var and parents its own spans
+        under the sync operation that launched it. Empty when no span is
+        active; re-exported per command so retries after a shell revive
+        carry the CURRENT attempt's context, not the dead shell's."""
+        from ..obs import tracing
+
+        tp = tracing.current_traceparent()
+        if not tp:
+            return ""
+        return f"TRACEPARENT={shlex.quote(tp)}; export TRACEPARENT; "
+
     # -- generic command ---------------------------------------------------
     def run(self, script: str, timeout: float = 60.0) -> str:
         """Run a script; returns its stdout. The script must not read stdin."""
         with self._lock:
             _, done, err = self._tokens()
             wrapped = (
+                f"{self._trace_env()}"
                 f"if {{ {script}\n}}; then printf '\\n%s\\n' {done}; "
                 f"else printf '\\n%s\\n' {err}; fi\n"
             )
@@ -167,6 +183,7 @@ class RemoteShell:
             # one spool per shell suffices. Removed on close().
             tmp = '"/tmp/.ds-up-$$"'
             script = (
+                f"{self._trace_env()}"
                 f"printf '%s\\n' {start}; "
                 f"if head -c {len(tar_bytes)} > {tmp} "
                 f"&& tar xzpf {tmp} -C {q}; "

@@ -7,6 +7,14 @@ the trace lands in ``.devspace/logs/trace.jsonl`` (one JSON object per
 span) plus an optional Chrome ``chrome://tracing`` export. Overhead is a
 clock read and one dict per span — nothing in the hot sync loops
 themselves, only around them.
+
+Since ISSUE 8 this module is a **shim over obs/tracing.py**: ``span()``
+delegates identity and parentage to the process tracer, so every legacy
+record additionally carries real ``trace_id`` / ``span_id`` /
+``parent_span_id`` fields and participates in distributed traces (the
+``traceparent`` that crosses the sync exec boundary is the tracer's
+active context). The dict ring, the JSONL file, and the Chrome export
+keep their exact old shapes — extra id keys ride along in ``args``.
 """
 
 from __future__ import annotations
@@ -54,18 +62,37 @@ def _stack() -> list[str]:
     return _tls.stack
 
 
+_LEGACY_KEYS = (
+    "name", "parent", "thread", "start",
+    "trace_id", "span_id", "parent_span_id", "duration_s",
+)
+
+
 @contextmanager
 def span(name: str, **attrs: Any) -> Iterator[dict]:
     """Time a phase. Nested spans record their parent; the yielded dict can
-    be updated with extra attributes mid-span."""
+    be updated with extra attributes mid-span.
+
+    Identity (trace_id/span_id/parent_span_id) comes from the process
+    tracer (obs/tracing.py): nesting follows the tracer's thread-local
+    context, including contexts re-attached across thread pools or
+    parsed from a ``traceparent`` header — the legacy name-based
+    ``parent`` field is kept alongside for old consumers."""
+    from ..obs import tracing as _tracing  # lazy: avoid import cycles
+
+    tracer = _tracing.get_tracer()
     parent = _stack()[-1] if _stack() else None
     _stack().append(name)
+    sp = tracer.start_span(name, attrs=dict(attrs))
     record: dict[str, Any] = {
         "name": name,
         "parent": parent,
         "thread": threading.current_thread().name,
-        "start": time.time(),
+        "start": sp.start,
         **attrs,
+        "trace_id": sp.trace_id,
+        "span_id": sp.span_id,
+        "parent_span_id": sp.parent_id,
     }
     t0 = time.perf_counter()
     try:
@@ -78,6 +105,13 @@ def span(name: str, **attrs: Any) -> Iterator[dict]:
     finally:
         _stack().pop()
         record["duration_s"] = round(time.perf_counter() - t0, 6)
+        # mirror caller-added attributes onto the real span, then close it
+        sp.attrs.update(
+            {k: v for k, v in record.items() if k not in _LEGACY_KEYS}
+        )
+        tracer.end_span(
+            sp, ok=record.get("ok", False), error=record.get("error")
+        )
         _emit(record)
 
 

@@ -4,8 +4,15 @@ by `devspace-tpu analyze`.
 
 Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
 optional "temperature", "eos_id", "top_k", "top_p"}), /healthz, /metrics
-(Prometheus text exposition) and /debug/requests (recent per-request
-serving traces). Concurrent requests are
+(Prometheus text exposition; OpenMetrics with exemplars when the client
+Accepts application/openmetrics-text), /debug/requests (recent
+per-request serving traces; ?limit=N caps rows, ?outcome=completed|
+cancelled|failed|in-flight filters) and /debug/trace?seconds=N (records
+the engine timeline for N seconds and returns Chrome-trace JSON —
+docs/observability.md "Timeline profiler", or `devspace-tpu profile
+serving`). An inbound W3C `traceparent` header on /generate or
+/generate_speculative joins the request's serving spans to the caller's
+distributed trace. Concurrent requests are
 continuously batched by devspace_tpu.inference.InferenceEngine
 (iteration-level scheduling — a long generation never blocks a short one).
 Defaults to the TINY config so it runs anywhere; set MODEL=llama2-7b on a
@@ -148,7 +155,9 @@ class Server:
             )
         self.engine.start()
 
-    def generate_speculative(self, prompt_ids, max_new_tokens, k=None):
+    def generate_speculative(
+        self, prompt_ids, max_new_tokens, k=None, traceparent=None
+    ):
         """Greedy generation through the ENGINE's speculative path
         (lossless vs /generate at temperature 0). Returns (tokens,
         engine-cumulative speculation stats)."""
@@ -170,7 +179,9 @@ class Server:
                 )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        req = self.engine.submit(prompt_ids, max_new_tokens)
+        req = self.engine.submit(
+            prompt_ids, max_new_tokens, traceparent=traceparent
+        )
         tokens = req.result(timeout=600)
         st = self.engine.stats()
         return tokens, {
@@ -196,6 +207,7 @@ class Server:
         stop=None,
         min_new_tokens=0,
         logit_bias=None,
+        traceparent=None,
     ):
         req = self.engine.submit(
             prompt_ids,
@@ -207,6 +219,7 @@ class Server:
             stop=stop,
             min_new_tokens=min_new_tokens,
             logit_bias=logit_bias,
+            traceparent=traceparent,
         )
         return req.result(timeout=600)
 
@@ -240,7 +253,11 @@ def main(argv=None):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            from urllib.parse import parse_qs
+
+            path, _, query = self.path.partition("?")
+            qs = parse_qs(query)
+            if path == "/healthz":
                 self._json(
                     200,
                     {
@@ -249,31 +266,85 @@ def main(argv=None):
                         **server.engine.stats(),
                     },
                 )
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 # Prometheus text exposition: the engine's private
                 # registry (serving histograms + engine gauges) plus the
                 # process-wide default registry (sync/resilience/trace) —
                 # name prefixes are disjoint, so concatenation is safe.
+                # Clients that Accept application/openmetrics-text get the
+                # OpenMetrics rendering instead, whose TTFT/e2e histogram
+                # buckets carry trace_id exemplars (the "# EOF" terminator
+                # of the engine part is dropped so the concatenation stays
+                # one well-formed document).
                 from devspace_tpu.obs import get_registry
 
-                body = (
-                    server.engine.metrics_text() + get_registry().render()
-                ).encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                openmetrics = "application/openmetrics-text" in (
+                    self.headers.get("Accept") or ""
                 )
+                if openmetrics:
+                    ereg = server.engine.metrics_registry
+                    engine_part = (
+                        ereg.render_openmetrics().rsplit("# EOF", 1)[0]
+                        if ereg is not None
+                        else ""
+                    )
+                    body = engine_part + get_registry().render_openmetrics()
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                    )
+                else:
+                    body = (
+                        server.engine.metrics_text() + get_registry().render()
+                    )
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
                 self.end_headers()
-                self.wfile.write(body)
-            elif self.path == "/debug/requests":
+                self.wfile.write(body.encode())
+            elif path == "/debug/requests":
                 tel = server.engine.telemetry
+                try:
+                    limit = int(qs.get("limit", ["50"])[0])
+                except ValueError:
+                    self._json(400, {"error": "limit must be an integer"})
+                    return
+                outcome = qs.get("outcome", [None])[0]
+                # filter the FULL ring, then keep the newest `limit` rows —
+                # filtering after a 50-row cut would under-report rare
+                # outcomes (e.g. ?outcome=failed on a mostly-healthy server)
+                rows = tel.recent(4096) if tel is not None else []
+                if outcome is not None:
+                    rows = [
+                        r
+                        for r in rows
+                        if (r.get("outcome") or "in-flight") == outcome
+                    ]
                 self._json(
                     200,
                     {
                         "metrics_enabled": tel is not None,
-                        "requests": tel.recent(50) if tel is not None else [],
+                        "requests": rows[-max(0, limit):] if limit else [],
                     },
                 )
+            elif path == "/debug/trace":
+                # On-demand timeline capture: record the engine's scheduler
+                # iterations, overlapped decode dispatches, readback waits
+                # and KV-tier restores for N seconds, reply with
+                # Chrome-trace JSON (load in chrome://tracing / Perfetto).
+                # Runs on this handler thread; concurrent captures replace
+                # each other (last start wins) rather than queueing.
+                try:
+                    seconds = float(qs.get("seconds", ["2"])[0])
+                except ValueError:
+                    self._json(400, {"error": "seconds must be a number"})
+                    return
+                if not 0 < seconds <= 60:
+                    self._json(
+                        400, {"error": "seconds must be in (0, 60]"}
+                    )
+                    return
+                self._json(200, server.engine.capture_timeline(seconds))
             else:
                 self._json(404, {"error": "not found"})
 
@@ -313,6 +384,7 @@ def main(argv=None):
                         req["prompt_ids"],
                         int(req.get("max_new_tokens", 16)),
                         k=(int(req["k"]) if "k" in req else None),
+                        traceparent=self.headers.get("traceparent"),
                     )
                     self._json(200, {"tokens": toks, "speculative": stats})
                 except SpecDisabled as e:
@@ -344,6 +416,9 @@ def main(argv=None):
                         if req.get("logit_bias")
                         else None
                     ),
+                    # W3C trace context: the request's serving spans join
+                    # the caller's distributed trace when present
+                    traceparent=self.headers.get("traceparent"),
                 )
                 prompt = req["prompt_ids"]
                 n = int(req.get("max_new_tokens", 16))

@@ -1,0 +1,151 @@
+"""Diff two bench result files and flag regressions > 5%.
+
+Usage::
+
+    python scripts/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python scripts/bench_compare.py old.json new.json --threshold 3
+
+Accepts both shapes BENCH_*.json appears in: the flat dict ``bench.py
+--out`` writes, and the driver's wrapper files whose measurement lives
+under ``"parsed"``. Comparison is direction-aware — latencies / wall
+times / overhead percentages regress when they grow, throughputs /
+speedups / utilization regress when they shrink — and configuration or
+event-count keys (dispatch_depth, kv_spill_blocks, ...) are reported
+only when they changed, never flagged. Exit status: 0 clean, 1 when any
+metric regressed past the threshold, 2 on usage errors. BENCH_*.json
+stops being write-only: round N+1's driver can gate on this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# keys that describe the workload or count events rather than measure
+# performance — a change is worth seeing but is not a regression
+INFORMATIONAL = {
+    "dispatch_depth",
+    "requests",
+    "new_tokens",
+    "carry_updates",
+    "kv_pressure_requests",
+    "kv_pressure_oversubscription",
+    "kv_spill_blocks",
+    "kv_restore_hits",
+    "kv_restore_fallbacks",
+    "kv_recompute_tokens_saved",
+    "kv_pressure_preemptions",
+    "kv_pressure_preemptions_off",
+}
+
+# non-numeric context keys, never compared
+SKIPPED = {"metric", "unit", "status", "reason", "baseline", "platform",
+           "lm_platform", "serving_platform"}
+
+
+def lower_is_better(key: str) -> bool:
+    """Latency/wall-time/overhead keys regress upward; everything else
+    numeric (throughput, speedup, MFU, hit rates, vs_baseline) regresses
+    downward."""
+    if "overhead" in key:
+        return True
+    return key.endswith(("_ms", "_us", "_s"))
+
+
+def flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, prefix=f"{name}."))
+        else:
+            out[name] = v
+    return out
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        d = json.load(fh)
+    # driver wrapper files carry the measurement under "parsed"
+    if "parsed" in d and isinstance(d["parsed"], dict):
+        d = d["parsed"]
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return flatten(d)
+
+
+def compare(old: dict, new: dict, threshold: float) -> tuple[list, list, list]:
+    """Returns (regressions, improvements, info_changes) as
+    (key, old, new, pct) tuples; pct is signed change in the metric's
+    "badness" direction (positive = regressed)."""
+    regressions, improvements, info = [], [], []
+    for key in sorted(set(old) & set(new)):
+        base = key.rsplit(".", 1)[-1]
+        ov, nv = old[key], new[key]
+        if base in SKIPPED or not isinstance(ov, (int, float)) \
+                or not isinstance(nv, (int, float)) \
+                or isinstance(ov, bool) or isinstance(nv, bool):
+            continue
+        if base in INFORMATIONAL:
+            if ov != nv:
+                info.append((key, ov, nv, None))
+            continue
+        if ov == 0:
+            continue  # can't express a ratio against a zero baseline
+        delta_pct = (nv - ov) / abs(ov) * 100.0
+        if lower_is_better(base):
+            delta_pct = -delta_pct  # growth is bad -> positive badness
+        badness = -delta_pct
+        if badness > threshold:
+            regressions.append((key, ov, nv, badness))
+        elif badness < -threshold:
+            improvements.append((key, ov, nv, -badness))
+    return regressions, improvements, info
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline bench JSON (earlier round)")
+    ap.add_argument("new", help="candidate bench JSON (later round)")
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=5.0,
+        help="flag changes past this percentage (default 5)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        old, new = load(args.old), load(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    regressions, improvements, info = compare(old, new, args.threshold)
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+
+    def show(rows, sign):
+        for key, ov, nv, pct in rows:
+            print(f"  {key}: {ov} -> {nv} ({sign}{pct:.1f}%)")
+
+    if regressions:
+        print(f"REGRESSIONS (> {args.threshold:g}%):")
+        show(regressions, "-")
+    if improvements:
+        print(f"improvements (> {args.threshold:g}%):")
+        show(improvements, "+")
+    if info:
+        print("workload/count changes (informational):")
+        for key, ov, nv, _ in info:
+            print(f"  {key}: {ov} -> {nv}")
+    if only_old:
+        print(f"keys only in {args.old}: {', '.join(only_old)}")
+    if only_new:
+        print(f"keys only in {args.new}: {', '.join(only_new)}")
+    if not (regressions or improvements):
+        print(f"no metric moved more than {args.threshold:g}%")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,10 @@ REQUIRED_CHAOS_MODULES = (
     # spilled payload must be dropped on digest mismatch, never
     # scattered into the pool
     "test_kv_tier",
+    # trace-context propagation under injected sync failures (ISSUE 8):
+    # a retry must re-attach the originating trace; a dropped worker's
+    # upload span must close with outcome=failed
+    "test_obs_tracing",
 )
 
 

@@ -49,6 +49,7 @@ def load_catalogs() -> dict[str, tuple]:
     matters only for jax (engine); everything else is dependency-free."""
     from devspace_tpu.inference.engine import ENGINE_METRIC_FAMILIES
     from devspace_tpu.obs.request_trace import SERVING_METRIC_FAMILIES
+    from devspace_tpu.obs.tracing import TRACING_METRIC_FAMILIES
     from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
     from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
     from devspace_tpu.utils.trace import TRACE_METRIC_FAMILIES
@@ -59,6 +60,7 @@ def load_catalogs() -> dict[str, tuple]:
         "sync": SYNC_METRIC_FAMILIES,
         "resilience": RESILIENCE_METRIC_FAMILIES,
         "trace": TRACE_METRIC_FAMILIES,
+        "tracing": TRACING_METRIC_FAMILIES,
     }
 
 
@@ -130,16 +132,30 @@ def check_registrable(catalogs: dict[str, tuple]) -> list[str]:
     return problems
 
 
+def check_timeline_tracks() -> list[str]:
+    """Timeline-lane catalog lint (obs/tracing.py): every Chrome-export
+    track name must be nonempty and unique, or the profiler UI silently
+    merges/anonymizes lanes."""
+    from devspace_tpu.obs import tracing
+
+    return tracing.lint_tracks()
+
+
 def main() -> int:
     catalogs = load_catalogs()
-    problems = lint(catalogs) + check_registrable(catalogs)
+    problems = (
+        lint(catalogs) + check_registrable(catalogs) + check_timeline_tracks()
+    )
     n = sum(len(f) for f in catalogs.values())
     for p in problems:
         print(f"ERROR {p}")
     if problems:
         print(f"{len(problems)} problem(s) across {n} metric families")
         return 1
-    print(f"ok: {n} metric families across {len(catalogs)} catalogs")
+    print(
+        f"ok: {n} metric families across {len(catalogs)} catalogs; "
+        "timeline track names unique"
+    )
     return 0
 
 

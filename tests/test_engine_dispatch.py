@@ -173,6 +173,39 @@ def test_overlap_matches_serial_under_preemption(params):
 
 
 @pytest.mark.slow
+def test_sampled_overlap_matches_serial_under_preemption(params):
+    """ROADMAP item 2 pin: SAMPLED streams must survive preemption
+    schedule-invariantly. The overlapped loop's preemption point moves
+    with drain timing, so this holds only because the key consumed for
+    committed token k is a function of k alone
+    (``fold_in(PRNGKey(seed), position)`` — see dispatch.py docstring):
+    depth-2 sampled streams under pool pressure must equal the serial
+    loop's token-for-token. Same contention config as the greedy
+    variant (slow-marked like it); the fast-tier pin for the same
+    invariant is test_randomized_traces_tier_invariant at depth 2."""
+    p1, p2 = [2, 3, 4, 5], [9, 8, 7]
+    reqs = [
+        dict(
+            prompt_ids=p, max_new_tokens=40,
+            temperature=0.8, seed=11 + n, top_k=8,
+        )
+        for n, p in enumerate((p1, p2, p1, p2))
+    ]
+    kw = dict(
+        max_slots=2, max_len=64, block_size=8, n_blocks=10, prefill_chunk=8
+    )
+    serial = run_trace(params, 1, reqs, **kw)
+    overlap = run_trace(params, 2, reqs, **kw)
+    assert all(e is None for e in serial[1] + overlap[1])
+    assert overlap[0] == serial[0], (
+        "sampled stream moved with the preemption schedule"
+    )
+    assert overlap[2]["requests_preempted"] >= 1, (
+        "trace did not exercise pool pressure"
+    )
+
+
+@pytest.mark.slow
 def test_overlap_with_speculative_engine(params):
     """Spec rounds interleave with the window (drain-before-spec):
     greedy speculative decoding stays lossless at depth 2. Slow-marked

@@ -402,12 +402,12 @@ def test_randomized_traces_tier_invariant(params, trial):
     seeded-sampled requests). int8 KV pool so restores are bit-exact —
     equality is a hard invariant, not a tie-free-trace property.
 
-    dispatch_depth=1 pins the schedule: a SAMPLED request's stream is
-    not schedule-invariant across preemption in the overlapped loop
-    (where the preemption point varies with drain timing) — a
-    tier-independent property, observed off-vs-off with the tier never
-    constructed. The serial loop makes both arms deterministic, so this
-    asserts exactly the tier's contribution: zero."""
+    Runs under the OVERLAPPED loop (default depth 2): sampled streams
+    are schedule-invariant across preemption since the position-keyed
+    PRNG scheme (ROADMAP item 2) — the key for committed token k is
+    ``fold_in(PRNGKey(seed), position_of(k-1))``, a function of k
+    alone, so drain-timing-dependent preemption points can no longer
+    move a sampled stream."""
     rng = np.random.default_rng(7 + trial)
     reqs = []
     for i in range(5):
@@ -419,7 +419,7 @@ def test_randomized_traces_tier_invariant(params, trial):
         if i % 2:
             r.update(temperature=0.8, seed=trial * 10 + i, top_k=8)
         reqs.append(r)
-    kw = dict(kv_dtype="int8", dispatch_depth=1)
+    kw = dict(kv_dtype="int8", dispatch_depth=2)
     off, _ = _run(params, reqs, "off", **kw)
     host, _ = _run(params, reqs, "host", **kw)
     assert off == host, f"trial {trial} diverged"

@@ -1,0 +1,398 @@
+"""Distributed tracing + timeline profiler (ISSUE 8).
+
+Pins the tentpole's contracts:
+
+- golden span parentage for a full request lifecycle (deterministic
+  clock, literal derived span ids — blake2b is stable, so these hex
+  strings must never drift);
+- W3C traceparent round-trip under fuzz plus strict rejection of
+  malformed headers;
+- tracer context mechanics (nesting, cross-thread attach, detached
+  roots, ring bounds);
+- timeline lane assignment in the Chrome export;
+- two chaos-marked propagation tests (scripts/chaos_check.py): span
+  context survives a sync retry after a shell revive, and a worker
+  dropped mid-upload closes its span with ``outcome=failed``.
+"""
+
+import random
+import time
+
+import pytest
+
+from devspace_tpu.obs.request_trace import ServingTelemetry
+from devspace_tpu.obs.tracing import (
+    SpanContext,
+    TimelineRecorder,
+    Tracer,
+    derive_span_id,
+    device_decode_track,
+    get_tracer,
+    lint_tracks,
+    new_span_id,
+    new_trace_id,
+)
+
+TRACE_ID = "ab" * 16
+PARENT_SPAN = "cd" * 8
+TRACEPARENT = f"00-{TRACE_ID}-{PARENT_SPAN}-01"
+
+# golden derived ids: derive_span_id is blake2b-8 over "parent/name" —
+# a pure function, so the lifecycle's ids are literal constants
+ROOT_SID = "77390ce345112f59"  # derive_span_id(TRACE_ID, "request-1")
+QUEUE_SID = "ce9b8d1228398faf"
+PREFILL_SID = "5300739846f8314b"
+DECODE_SID = "9c117bdf9b1eca16"
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeReq:
+    def __init__(self, traceparent=None):
+        self.prompt_ids = [1, 2, 3]
+        self.max_new_tokens = 4
+        self.traceparent = traceparent
+
+
+# -- golden span parentage ---------------------------------------------------
+def test_golden_request_lifecycle_span_parentage():
+    """enqueue->admit->prefill->3 tokens->finish under a hand-ticked
+    clock: every span id, parent link, lane and duration is asserted
+    literally."""
+    clock = FakeClock()
+    tel = ServingTelemetry(clock=clock)
+    req = FakeReq(traceparent=TRACEPARENT)
+    tel.on_submit(req)
+    trace = req._obs_trace
+    assert trace.trace_id == TRACE_ID  # joined the caller's trace
+    assert trace.parent_span_id == PARENT_SPAN
+    assert trace.span_id == ROOT_SID
+    assert trace.span_id == derive_span_id(TRACE_ID, "request-1")
+
+    clock.t = 101.0
+    tel.on_admit(req)
+    clock.t = 102.0
+    tel.on_prefill_done(req)
+    for t in (103.0, 104.0, 105.0):
+        clock.t = t
+        tel.on_emit(req)
+    clock.t = 106.0
+    tel.on_finish(req, "completed")
+
+    spans = {s["name"]: s for s in trace.to_spans()}
+    assert set(spans) == {"queue_wait", "prefill", "decode", "request-1"}
+
+    root = spans["request-1"]
+    assert root["span_id"] == ROOT_SID
+    assert root["parent_span_id"] == PARENT_SPAN
+    assert root["trace_id"] == TRACE_ID
+    assert root["duration_s"] == pytest.approx(6.0)
+    assert root["outcome"] == "completed" and root["ok"] is True
+
+    golden = {
+        "queue_wait": (QUEUE_SID, 1.0),
+        "prefill": (PREFILL_SID, 1.0),
+        "decode": (DECODE_SID, 2.0),
+    }
+    for name, (sid, dur) in golden.items():
+        sp = spans[name]
+        assert sp["span_id"] == sid
+        assert sp["span_id"] == derive_span_id(ROOT_SID, name)
+        assert sp["parent_span_id"] == ROOT_SID
+        assert sp["trace_id"] == TRACE_ID
+        assert sp["duration_s"] == pytest.approx(dur)
+        # lane assignment: every request-lifecycle span renders on the
+        # "serving" lane of the shared Chrome-trace writer
+        assert sp["thread"] == "serving"
+    assert root["thread"] == "serving"
+    assert spans["decode"]["tokens"] == 3
+
+    row = trace.to_dict()
+    assert row["trace_id"] == TRACE_ID  # /debug/requests cross-link
+    assert row["ttft_s"] == pytest.approx(3.0)
+
+
+def test_request_without_traceparent_roots_fresh_trace():
+    tel = ServingTelemetry(clock=FakeClock())
+    req = FakeReq()
+    tel.on_submit(req)
+    trace = req._obs_trace
+    assert trace.parent_span_id is None
+    assert len(trace.trace_id) == 32 and int(trace.trace_id, 16)
+    assert trace.span_id == derive_span_id(trace.trace_id, "request-1")
+
+
+def test_malformed_inbound_traceparent_is_dropped_not_joined():
+    tel = ServingTelemetry(clock=FakeClock())
+    req = FakeReq(traceparent=f"00-{'0' * 32}-{PARENT_SPAN}-01")
+    tel.on_submit(req)
+    assert req._obs_trace.trace_id != "0" * 32
+    assert req._obs_trace.parent_span_id is None
+
+
+# -- traceparent round-trip --------------------------------------------------
+def test_traceparent_round_trip_fuzz():
+    rng = random.Random(0)
+    rand = lambda n: bytes(rng.getrandbits(8) for _ in range(n))  # noqa: E731
+    for _ in range(300):
+        ctx = SpanContext.generate(rand=rand)
+        header = ctx.to_traceparent()
+        version, tid, sid, flags = header.split("-")
+        assert (version, flags) == ("00", "01")
+        assert (len(tid), len(sid)) == (32, 16)
+        back = SpanContext.from_traceparent(header)
+        assert back == ctx
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        f"00-{TRACE_ID}-{PARENT_SPAN}",  # missing flags
+        f"00-{TRACE_ID}-{PARENT_SPAN}-01-extra",
+        f"ff-{TRACE_ID}-{PARENT_SPAN}-01",  # forbidden version
+        f"00-{'0' * 32}-{PARENT_SPAN}-01",  # all-zero trace id
+        f"00-{TRACE_ID}-{'0' * 16}-01",  # all-zero span id
+        f"00-{TRACE_ID.upper()}-{PARENT_SPAN}-01",  # uppercase hex
+        f"00-{TRACE_ID[:-1]}-{PARENT_SPAN}-01",  # short trace id
+        f"00-{TRACE_ID}-{PARENT_SPAN}-0g",  # non-hex flags
+        f"00-{TRACE_ID}-{PARENT_SPAN[:-1]}x-01",  # non-hex span id
+    ],
+)
+def test_traceparent_rejects_malformed(header):
+    assert SpanContext.from_traceparent(header) is None
+
+
+def test_id_generators_never_all_zero():
+    zero_then_real = [b"\x00" * 16, b"\xab" * 16, b"\x00" * 8, b"\xcd" * 8]
+    rand = lambda n: zero_then_real.pop(0)[:n]  # noqa: E731
+    assert new_trace_id(rand) == "ab" * 16
+    assert new_span_id(rand) == "cd" * 8
+
+
+# -- tracer context mechanics ------------------------------------------------
+def test_nested_spans_parent_and_share_trace():
+    tr = Tracer(clock=FakeClock(), perf=FakeClock(0.0))
+    with tr.span("outer") as outer:
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tr.current_context() is None
+    assert [s.name for s in tr.recent()] == ["inner", "outer"]
+
+
+def test_attach_carries_context_across_threads():
+    import threading
+
+    tr = Tracer()
+    root = tr.start_span("root", push=False)  # detached: stack untouched
+    assert tr.current_context() is None
+    seen = {}
+
+    def worker():
+        with tr.attach(root.context):
+            with tr.span("child") as sp:
+                seen["parent"] = sp.parent_id
+                seen["trace"] = sp.trace_id
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tr.end_span(root, ok=True)
+    assert seen == {"parent": root.span_id, "trace": root.trace_id}
+
+
+def test_attach_none_is_noop():
+    tr = Tracer()
+    with tr.attach(None):
+        assert tr.current_context() is None
+
+
+def test_ring_keeps_newest_and_counts_drops():
+    tr = Tracer(ring=3)
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    assert [s.name for s in tr.recent()] == ["s2", "s3", "s4"]
+    assert tr.dropped == 2 and tr.started == 5
+
+
+# -- timeline lanes ----------------------------------------------------------
+def test_timeline_chrome_export_lane_assignment():
+    tl = TimelineRecorder()
+    t0 = time.monotonic()
+    tl.add("host sched", "iteration", t0, t0 + 0.001)
+    tl.add(device_decode_track(0), "decode x4", t0, t0 + 0.002, slots=[0])
+    tl.add(device_decode_track(1), "decode x4", t0 + 0.001, t0 + 0.003)
+    doc = tl.chrome()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["tid"] for e in xs] == [
+        "host sched", "device decode/0", "device decode/1",
+    ]
+    assert all(e["pid"] == 1 and e["dur"] >= 0 for e in xs)
+    named = {
+        e["tid"]: e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert named == {t: t for t in (
+        "host sched", "device decode/0", "device decode/1",
+    )}
+    assert doc["metadata"]["events"] == 3
+
+
+def test_timeline_rejects_unnamed_track_and_bounds_events():
+    tl = TimelineRecorder(max_events=2)
+    tl.add("a", "e1", 0.0, 1.0)
+    tl.add("a", "e2", 0.0, 1.0)
+    tl.add("a", "e3", 0.0, 1.0)  # over the cap: dropped, counted
+    assert tl.dropped == 1
+    bad = TimelineRecorder()
+    bad.add("  ", "anon", 0.0, 1.0)
+    with pytest.raises(ValueError, match="unnamed track"):
+        bad.chrome()
+    assert lint_tracks() == []  # the static lane catalog itself is clean
+
+
+# -- chaos: context propagation under sync failure (scripts/chaos_check.py) --
+def _wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _make_session(tmp_path, cluster, n_workers):
+    from devspace_tpu.sync.session import SyncOptions, SyncSession
+    from devspace_tpu.utils.fsutil import write_file
+
+    local = tmp_path / "local"
+    local.mkdir(exist_ok=True)
+    write_file(str(local / "base.py"), "v0")
+    workers = [
+        cluster.add_pod(f"w-{i}", labels={"app": "t"}, worker_id=i)
+        for i in range(n_workers)
+    ]
+    opts = SyncOptions(
+        local_path=str(local),
+        container_path="/app",
+        upstream_quiet=0.15,
+        upstream_tick=0.05,
+        downstream_interval=0.15,
+    )
+    return SyncSession(cluster, workers, opts), local, workers
+
+
+def _upload_spans(trace_id):
+    return [
+        s
+        for s in get_tracer().find(trace_id)
+        if s.name == "sync.upload"
+    ]
+
+
+@pytest.mark.chaos
+def test_span_context_survives_sync_retry(tmp_path):
+    """A transient upload failure followed by a successful shell revive:
+    the retry's span must re-attach the SAME trace as the first attempt —
+    a retry that roots a fresh trace would orphan the recovery from the
+    operation it recovered."""
+    from devspace_tpu.kube.fake import FakeCluster
+    from devspace_tpu.resilience.chaos import ByteBudgetStream
+    from devspace_tpu.utils.fsutil import write_file
+
+    cluster = FakeCluster(str(tmp_path / "cluster"))
+    session, local, workers = _make_session(tmp_path, cluster, n_workers=2)
+    session.start()
+    try:
+        trace_id = session._session_span.trace_id
+        _wait_for(
+            lambda: session.initial_sync_done.is_set(), msg="initial sync"
+        )
+        # next byte to worker 1 fails; revive (exec_stream intact) succeeds
+        session._shells[1].proc = ByteBudgetStream(session._shells[1].proc, 0)
+        write_file(str(local / "edit.py"), "v1")
+        _wait_for(
+            lambda: any(
+                s.attrs.get("retry") for s in _upload_spans(trace_id)
+            ),
+            msg="retried upload span",
+        )
+    finally:
+        session.stop()
+    assert session.error is None and not session.worker_errors
+    retries = [
+        s for s in _upload_spans(trace_id) if s.attrs.get("retry")
+    ]
+    assert retries, "revive path recorded no retry span"
+    sp = retries[-1]
+    assert sp.trace_id == trace_id  # context survived the retry
+    assert sp.attrs["worker"] == 1
+    assert sp.attrs["outcome"] == "delivered" and sp.ok is True
+    # the failed first attempt is on the same trace too
+    firsts = [
+        s
+        for s in _upload_spans(trace_id)
+        if not s.attrs.get("retry") and s.attrs.get("worker") == 1
+        and s.attrs.get("outcome") == "failed"
+    ]
+    assert firsts and firsts[-1].ok is False
+
+
+@pytest.mark.chaos
+def test_dropped_worker_closes_span_with_outcome_failed(
+    tmp_path, monkeypatch
+):
+    """A worker dropped mid-upload (stream dead, revive impossible) is
+    quarantined — and its last upload span must close failed with the
+    error recorded, not leak open or report delivered."""
+    from devspace_tpu.kube.fake import FakeCluster
+    from devspace_tpu.resilience.chaos import ByteBudgetStream
+    from devspace_tpu.utils.fsutil import write_file
+
+    cluster = FakeCluster(str(tmp_path / "cluster"))
+    session, local, workers = _make_session(tmp_path, cluster, n_workers=3)
+    session.start()
+    try:
+        trace_id = session._session_span.trace_id
+        _wait_for(
+            lambda: session.initial_sync_done.is_set(), msg="initial sync"
+        )
+        real_exec = cluster.exec_stream
+
+        def exec_stream(pod, *a, **kw):
+            if getattr(pod, "name", pod) == workers[1].name:
+                raise RuntimeError("pod gone")
+            return real_exec(pod, *a, **kw)
+
+        monkeypatch.setattr(cluster, "exec_stream", exec_stream)
+        session._shells[1].proc = ByteBudgetStream(session._shells[1].proc, 0)
+        write_file(str(local / "edit.py"), "v1")
+        _wait_for(lambda: 1 in session.worker_errors, msg="quarantine")
+    finally:
+        session.stop()
+    assert session.error is None  # graded ladder: session survives
+    failed = [
+        s
+        for s in _upload_spans(trace_id)
+        if s.attrs.get("worker") == 1 and s.attrs.get("outcome") == "failed"
+    ]
+    assert failed, "dropped worker left no failed upload span"
+    assert all(s.ok is False and s.error for s in failed)
+    # survivors' deliveries stay on the same trace, marked delivered
+    delivered = [
+        s
+        for s in _upload_spans(trace_id)
+        if s.attrs.get("outcome") == "delivered"
+    ]
+    assert delivered and all(s.ok for s in delivered)
