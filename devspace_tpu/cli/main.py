@@ -337,6 +337,27 @@ def _status_serving(args) -> int:
         ["STAT", "VALUE"],
         [[label, str(health.get(k, "-"))] for k, label in stat_keys],
     )
+    # SLO burn-rate statuses (obs/slo.py; ISSUE 9) — absent on servers
+    # predating the events+SLO layer
+    slo = health.get("slo")
+    if slo is not None:
+        if slo.get("slos"):
+            log.print_table(
+                ["SLO", "STATUS", "BURN(SHORT)", "BURN(LONG)"],
+                [
+                    [
+                        s.get("name", "?"),
+                        s.get("status", "?"),
+                        f"{s.get('burn_short', 0):.2f}",
+                        f"{s.get('burn_long', 0):.2f}",
+                    ]
+                    for s in slo["slos"]
+                ],
+            )
+            if not slo.get("ready", True):
+                log.warn("NOT READY: an SLO is in breach (/readyz -> 503)")
+        else:
+            log.info("slo: no evaluation yet (server just started)")
     try:
         debug = fetch("/debug/requests")
     except (urllib.error.URLError, OSError, ValueError):
@@ -599,6 +620,256 @@ def cmd_profile(args) -> int:
     )
     if lanes:
         log.info("lanes: %s", ", ".join(lanes))
+    return 0
+
+
+def _parse_prom_text(text: str) -> dict:
+    """Prometheus text exposition -> ``{name: [(labels, value)]}`` —
+    just enough parsing for ``top`` (scalar samples; histogram series
+    appear under their ``_bucket``/``_sum``/``_count`` names)."""
+    import re as _re
+
+    label_re = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, sval = line.rpartition(" ")
+        if not head:
+            continue
+        try:
+            value = float(sval)
+        except ValueError:
+            continue
+        name, _, rest = head.partition("{")
+        labels = dict(label_re.findall(rest)) if rest else {}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _prom_value(fams: dict, name: str, default=None):
+    """Sum of a family's samples (scalar for unlabeled metrics)."""
+    samples = fams.get(name)
+    if not samples:
+        return default
+    return sum(v for _labels, v in samples)
+
+
+def _human_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def cmd_top(args) -> int:
+    """``top``: live serving dashboard (ISSUE 9). Polls ``/metrics``
+    (windowed tok/s, dispatch occupancy, KV-tier bytes, queue depth, SLO
+    gauges) and ``/debug/events`` (recent structured events) from a
+    running inference server, redrawing every ``--interval`` seconds.
+    ``--iterations N`` renders N frames and exits (scripting/tests);
+    the default 0 runs until Ctrl-C."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    url = args.url.rstrip("/")
+
+    def fetch(path, parse_json):
+        with urllib.request.urlopen(url + path, timeout=5) as resp:
+            body = resp.read()
+        return _json.loads(body) if parse_json else body.decode()
+
+    tick = 0
+    try:
+        while True:
+            tick += 1
+            try:
+                fams = _parse_prom_text(fetch("/metrics", False))
+                health = fetch("/healthz", True)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                log.error("no serving endpoint at %s: %s", url, e)
+                return 1
+            try:
+                events = fetch(
+                    f"/debug/events?limit={args.events}", True
+                )
+            except (urllib.error.URLError, OSError, ValueError):
+                events = None  # older server: dashboard still useful
+
+            lines = []
+            stamp = _time.strftime("%H:%M:%S")
+            lines.append(
+                f"devspace-tpu top — {url}   {stamp}   frame {tick}"
+            )
+            lines.append("")
+
+            def v(name, fmt="{:.0f}", default="-"):
+                val = _prom_value(fams, name)
+                return fmt.format(val) if val is not None else default
+
+            slots = (
+                f"{v('engine_active_slots')}"
+                f"/{v('engine_max_slots')}"
+            )
+            blocks = (
+                f"{v('engine_free_kv_blocks')}"
+                f"/{v('engine_kv_blocks')}"
+            )
+            rows = [
+                ["tok/s (10s)", v("engine_tokens_per_sec_10s", "{:.1f}"),
+                 "active slots", slots],
+                ["dispatch occupancy",
+                 v("engine_dispatch_depth_occupancy", "{:.2f}"),
+                 "prefilling", v("engine_prefilling_slots")],
+                ["queue depth", v("engine_queued_requests"),
+                 "free kv blocks", blocks],
+                ["kv tier resident",
+                 _human_bytes(_prom_value(fams, "engine_kv_tier_resident_bytes")),
+                 "spilled blocks", v("engine_kv_spill_blocks_total")],
+                ["requests completed", v("engine_requests_completed_total"),
+                 "failed", v("engine_requests_failed_total")],
+            ]
+            w0 = max(len(r[0]) for r in rows)
+            w1 = max(len(r[1]) for r in rows)
+            w2 = max(len(r[2]) for r in rows)
+            for r in rows:
+                lines.append(
+                    f"  {r[0]:<{w0}}  {r[1]:>{w1}}    {r[2]:<{w2}}  {r[3]}"
+                )
+            lines.append("")
+
+            slo = (health or {}).get("slo") or {}
+            if slo.get("slos"):
+                lines.append("  SLO               STATUS  BURN(S)  BURN(L)")
+                for s in slo["slos"]:
+                    lines.append(
+                        f"  {s.get('name', '?'):<17} "
+                        f"{s.get('status', '?'):<7} "
+                        f"{s.get('burn_short', 0):>7.2f} "
+                        f"{s.get('burn_long', 0):>8.2f}"
+                    )
+                if not slo.get("ready", True):
+                    lines.append("  !! NOT READY (/readyz -> 503)")
+                lines.append("")
+
+            if events is not None and events.get("events"):
+                lines.append("  RECENT EVENTS")
+                for e in events["events"][-args.events:]:
+                    ts = _time.strftime(
+                        "%H:%M:%S", _time.localtime(e.get("time", 0))
+                    )
+                    attrs = " ".join(
+                        f"{k}={v2}"
+                        for k, v2 in e.items()
+                        if k not in (
+                            "time", "level", "subsystem", "event", "span_id"
+                        )
+                    )
+                    lines.append(
+                        f"  {ts}  {e.get('level', '?'):<5} "
+                        f"{e.get('subsystem', '?')}.{e.get('event', '?')}"
+                        f"  {attrs}"
+                    )
+            elif events is not None:
+                lines.append("  RECENT EVENTS: none recorded yet")
+
+            import sys as _sys
+
+            if _sys.stdout.isatty() and args.iterations != 1:
+                _sys.stdout.write("\x1b[2J\x1b[H")
+            print("\n".join(lines))
+            if args.iterations and tick >= args.iterations:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_debug(args) -> int:
+    """``debug bundle``: one incident-triage artifact (ISSUE 9) — a
+    .tar.gz of everything a running server can tell us: metrics
+    snapshot, health+SLO state, effective config, recent request traces,
+    flight-recorder events and (unless ``--seconds 0``) a Chrome
+    timeline capture. Endpoints that fail are recorded in the manifest
+    instead of aborting — partial evidence beats none mid-incident."""
+    import io as _io
+    import json as _json
+    import tarfile
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    url = args.url.rstrip("/")
+    if not 0 <= args.seconds <= 60:
+        log.error("--seconds must be in [0, 60], got %s", args.seconds)
+        return 1
+
+    def fetch(path, timeout):
+        with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+            return resp.read()
+
+    plan = [
+        ("metrics.txt", "/metrics", 10),
+        ("healthz.json", "/healthz", 10),
+        ("config.json", "/debug/config", 10),
+        ("requests.json", "/debug/requests?limit=500", 10),
+        ("events.json", "/debug/events?limit=2000", 10),
+    ]
+    if args.seconds > 0:
+        # the server blocks for the capture window before replying
+        plan.append(
+            ("timeline.json", f"/debug/trace?seconds={args.seconds}",
+             args.seconds + 30)
+        )
+    members: dict = {}
+    errors: dict = {}
+    for name, path, timeout in plan:
+        log.info("fetching %s ...", path)
+        try:
+            members[name] = fetch(path, timeout)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            errors[name] = str(e)
+    if not members:
+        log.error(
+            "no serving endpoint at %s: %s", url,
+            "; ".join(sorted(errors.values())) or "all fetches failed",
+        )
+        return 1
+    manifest = {
+        "url": url,
+        "created": _time.time(),
+        "members": sorted(members),
+        "errors": errors,
+    }
+    with tarfile.open(args.out, "w:gz") as tar:
+        def add(name, data):
+            info = tarfile.TarInfo("bundle/" + name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, _io.BytesIO(data))
+
+        add("manifest.json", _json.dumps(manifest, indent=2).encode())
+        for name in sorted(members):
+            add(name, members[name])
+    log.done(
+        "wrote %s (%d member(s)%s)", args.out, len(members) + 1,
+        f", {len(errors)} failed" if errors else "",
+    )
+    for name, err in sorted(errors.items()):
+        log.warn("  missing %s: %s", name, err)
     return 0
 
 
@@ -1671,6 +1942,61 @@ def build_parser() -> argparse.ArgumentParser:
         help="destination for the Chrome-trace JSON",
     )
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "top", help="live dashboard for a running inference server"
+    )
+    sp.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="base URL of a running inference server",
+    )
+    sp.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="seconds between dashboard refreshes",
+    )
+    sp.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="render N frames then exit (0 = run until Ctrl-C)",
+    )
+    sp.add_argument(
+        "--events",
+        type=int,
+        default=8,
+        help="recent structured events to show per frame",
+    )
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "debug", help="incident tooling for a running inference server"
+    )
+    debug_sub = sp.add_subparsers(dest="what", required=True)
+    q = debug_sub.add_parser(
+        "bundle",
+        help="tar.gz of metrics, health/SLO, config, request traces, "
+        "flight-recorder events and a timeline capture",
+    )
+    q.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="base URL of a running inference server",
+    )
+    q.add_argument(
+        "--out",
+        default="debug-bundle.tar.gz",
+        help="destination archive path",
+    )
+    q.add_argument(
+        "--seconds",
+        type=float,
+        default=2.0,
+        help="timeline capture window in seconds (0 skips the capture)",
+    )
+    q.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("add", help="add config entries")
     add_sub = sp.add_subparsers(dest="kind", required=True)

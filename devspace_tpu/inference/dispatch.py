@@ -85,6 +85,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import events as _events
 from ..obs.tracing import TRACK_READBACK, device_decode_track
 
 if TYPE_CHECKING:  # pragma: no cover — import cycle guard, types only
@@ -279,6 +280,10 @@ class DecodeDispatcher:
             self.inflight_steps[i] += k_steps
         self.dispatches += 1
         self.occupancy_sum += len(self.window)
+        _events.emit(
+            "dispatch", "depth_change", level="debug",
+            depth=len(self.window), direction="up", slots=len(plain),
+        )
 
     def drain(self, block: bool = False) -> int:
         """Retire in-flight chunks in dispatch order. ``block=True``
@@ -346,6 +351,10 @@ class DecodeDispatcher:
             if self.refs[i] == 0 and i in self.pending_free:
                 self.pending_free.discard(i)
                 eng._free_slot_blocks(i)
+        _events.emit(
+            "dispatch", "depth_change", level="debug",
+            depth=len(self.window), direction="down",
+        )
 
     def abandon(self) -> None:
         """Drop the whole in-flight window without reading it — the
@@ -354,6 +363,11 @@ class DecodeDispatcher:
         the window may be poisoned, and the carry was donated into the
         failed chain, so both are discarded; zombie blocks are released
         host-side (the allocator is about to be reset or reused)."""
+        if self.window:
+            _events.emit(
+                "dispatch", "window_abandoned", level="warn",
+                dropped=len(self.window),
+            )
         self.window.clear()
         B = self.engine.max_slots
         self.refs = [0] * B

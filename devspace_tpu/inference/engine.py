@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer as tfm
+from ..obs import events as _events
 from ..obs.metrics import Registry, WindowedRate, metrics_enabled
 from ..obs.request_trace import ServingTelemetry
 from ..obs.tracing import (
@@ -189,6 +190,14 @@ def sample_logits(key, logits, temperature, top_k=0, top_p=1.0):
     sampled = jax.random.categorical(key, filtered).astype(jnp.int32)
     greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
     return jnp.where(jnp.asarray(temperature, jnp.float32) > 0, sampled, greedy)
+
+
+def _req_trace_id(req) -> Optional[str]:
+    """The request's distributed trace id, when telemetry minted one.
+    Engine events stamp it explicitly: the scheduler thread never sees
+    the submitting thread's thread-local tracer context."""
+    trace = getattr(req, "_obs_trace", None)
+    return trace.trace_id if trace is not None else None
 
 
 @dataclass
@@ -1461,6 +1470,7 @@ class InferenceEngine:
         per _RESTORE_BATCH blocks: one compile total, one device sync
         per batch instead of per block."""
         R = _RESTORE_BATCH
+        spilled_bytes = 0
         for lo in range(0, len(items), R):
             group = items[lo : lo + R]
             idx = [blk for _, blk in group] + [0] * (R - len(group))
@@ -1476,6 +1486,10 @@ class InferenceEngine:
                 self._kv_tier.put(digest, payload)
                 self.kv_spill_blocks += 1
                 self.kv_spill_bytes += len(payload)
+                spilled_bytes += len(payload)
+        _events.emit(
+            "kv_tier", "spill", blocks=len(items), bytes=spilled_bytes
+        )
 
     def _on_tier_evict(self, digest: str) -> None:
         """The tier aged out / lost a payload: prune the matching
@@ -1606,6 +1620,16 @@ class InferenceEngine:
                 self._kv_tier.discard(digest)
                 for d in dropped:
                     self._kv_tier.discard(d)
+                slot_req = self.slots[slot_idx].req
+                _events.emit(
+                    "kv_tier", "restore_fallback", level="warn",
+                    trace_id=(
+                        _req_trace_id(slot_req)
+                        if slot_req is not None else None
+                    ),
+                    slot=slot_idx, digest=digest[:16],
+                    pruned=len(dropped),
+                )
                 break
             chain.append(parsed)
         if not chain:
@@ -1679,6 +1703,12 @@ class InferenceEngine:
                 overlapped=overlapped,
                 trace_id=trace.trace_id if trace is not None else None,
             )
+        _events.emit(
+            "kv_tier", "restore",
+            trace_id=trace.trace_id if trace is not None else None,
+            slot=slot_idx, blocks=restored, overlapped=overlapped,
+            seconds=round(now - t0, 6),
+        )
         return restored
 
     def _publish_prefix_blocks(self, slot_idx: int) -> None:
@@ -1734,6 +1764,10 @@ class InferenceEngine:
         and its chunks' requests are exactly the slot-resident ones
         failed below — nothing may read from or emit out of it after
         this point."""
+        _events.emit(
+            "engine", "fail_outstanding", level="error",
+            reason=reason, drain_queue=drain_queue,
+        )
         self._dispatcher.abandon()
         for i, slot in enumerate(self.slots):
             req = slot.req  # snapshot: a live scheduler may race us when
@@ -1748,6 +1782,11 @@ class InferenceEngine:
             self.requests_failed += 1
             if self.telemetry is not None:
                 self.telemetry.on_finish(req, "failed")
+            _events.emit(
+                "engine", "request_failed", level="error",
+                trace_id=_req_trace_id(req), reason=reason, slot=i,
+                stage="decode",
+            )
             self._finish(req)  # done LAST (see _emit)
         if not drain_queue:
             return
@@ -1756,6 +1795,10 @@ class InferenceEngine:
             self.requests_failed += 1
             if self.telemetry is not None:
                 self.telemetry.on_finish(req, "failed")
+            _events.emit(
+                "engine", "request_failed", level="error",
+                trace_id=_req_trace_id(req), reason=reason, stage="resume",
+            )
             self._finish(req)  # done LAST (see _emit)
         self._resume.clear()
         while True:
@@ -1767,6 +1810,10 @@ class InferenceEngine:
             self.requests_failed += 1
             if self.telemetry is not None:
                 self.telemetry.on_finish(req, "failed")
+            _events.emit(
+                "engine", "request_failed", level="error",
+                trace_id=_req_trace_id(req), reason=reason, stage="queued",
+            )
             self._finish(req)  # done LAST (see _emit)
 
     def _recover_pool_if_lost(self) -> None:
@@ -1903,6 +1950,11 @@ class InferenceEngine:
         self._sync_sampling_extras(slot_idx, req)
         if self.telemetry is not None:
             self.telemetry.on_admit(req)
+        _events.emit(
+            "engine", "admit", trace_id=_req_trace_id(req), slot=slot_idx,
+            prompt_tokens=len(prompt),
+            cached_blocks=len(matched) + restored,
+        )
         return True
 
     def _sync_sampling_extras(self, slot_idx: int, req: Request) -> None:
@@ -2112,6 +2164,11 @@ class InferenceEngine:
         self.requests_preempted += 1
         if self.telemetry is not None:
             self.telemetry.on_preempt(req)
+        _events.emit(
+            "engine", "preempt", level="warn",
+            trace_id=_req_trace_id(req), slot=i,
+            generated=len(req.tokens),
+        )
 
     def _publish_preempt_chain(self, i: int) -> None:
         """Tiered preemption: publish the slot's fully-WRITTEN blocks
@@ -2239,6 +2296,11 @@ class InferenceEngine:
                 self.requests_failed += 1
                 if self.telemetry is not None:
                     self.telemetry.on_finish(req, "failed")
+                _events.emit(
+                    "engine", "request_failed", level="error",
+                    trace_id=_req_trace_id(req), reason=str(e),
+                    stage="admit", slot=i,
+                )
                 self._recover_pool_if_lost()
                 self._finish(req)  # done LAST (see _emit)
 
@@ -2287,6 +2349,10 @@ class InferenceEngine:
         fail the WHOLE in-flight window (every chunk chains off the
         poisoned pool) rather than hang any caller, then rebuild a clean
         pool and keep serving new requests."""
+        _events.emit(
+            "engine", "poisoned_window", level="error", error=str(e),
+            in_flight=self._dispatcher.in_flight,
+        )
         self._fail_outstanding(f"decode failed: {e}", drain_queue=False)
         self._reset_pool()  # donated buffer is gone
         self._reset_draft_cache()
@@ -2356,6 +2422,11 @@ class InferenceEngine:
                         self.requests_failed += 1
                         if self.telemetry is not None:
                             self.telemetry.on_finish(req, "failed")
+                        _events.emit(
+                            "engine", "request_failed", level="error",
+                            trace_id=_req_trace_id(req), reason=str(e),
+                            stage="prefill", slot=i,
+                        )
                     self._recover_pool_if_lost()
                     self._reset_draft_cache()  # draft prefill may have died
                     if req is not None:

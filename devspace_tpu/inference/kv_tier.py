@@ -47,6 +47,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import events as _obs_events
+
 _MAGIC = b"KVT1"
 _CHECKSUM_SIZE = 16
 
@@ -196,6 +198,10 @@ class HostKVTier:
         checksum, payload = buf[:_CHECKSUM_SIZE], buf[_CHECKSUM_SIZE:]
         if len(checksum) != _CHECKSUM_SIZE or _checksum(payload) != checksum:
             self.corrupt_dropped += 1
+            _obs_events.emit(
+                "kv_tier", "corrupt_drop", level="error",
+                digest=digest[:16], tier="disk",
+            )
             return None
         return payload
 
@@ -247,6 +253,10 @@ class HostKVTier:
                 self._ram_bytes -= len(payload)
                 self.corrupt_dropped += 1
                 self.misses += 1
+                _obs_events.emit(
+                    "kv_tier", "corrupt_drop", level="error",
+                    digest=digest[:16], tier="ram",
+                )
                 return None
             self._ram.move_to_end(digest)
             self.hits += 1

@@ -12,6 +12,21 @@ the existing ``stats()`` dicts stay byte-compatible (they and the
 registry are two views over the same counters).
 """
 
+from .events import (
+    EVENT_CATALOG,
+    EVENT_SUBSYSTEMS,
+    EVENTS_METRIC_FAMILIES,
+    Event,
+    EventBus,
+    FlightRecorder,
+    JsonlSink,
+    add_sink,
+    emit,
+    events_enabled,
+    get_bus,
+    make_event,
+    remove_sink,
+)
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     Counter,
@@ -27,6 +42,13 @@ from .request_trace import (
     RequestTrace,
     ServingTelemetry,
 )
+from .slo import (
+    SLO_METRIC_FAMILIES,
+    SLOEvaluator,
+    SLOSpec,
+    SLOStatus,
+    default_serving_slos,
+)
 from .tracing import (
     TIMELINE_TRACKS,
     TRACING_METRIC_FAMILIES,
@@ -39,6 +61,24 @@ from .tracing import (
 )
 
 __all__ = [
+    "EVENT_CATALOG",
+    "EVENT_SUBSYSTEMS",
+    "EVENTS_METRIC_FAMILIES",
+    "Event",
+    "EventBus",
+    "FlightRecorder",
+    "JsonlSink",
+    "add_sink",
+    "emit",
+    "events_enabled",
+    "get_bus",
+    "make_event",
+    "remove_sink",
+    "SLO_METRIC_FAMILIES",
+    "SLOEvaluator",
+    "SLOSpec",
+    "SLOStatus",
+    "default_serving_slos",
     "TIMELINE_TRACKS",
     "TRACING_METRIC_FAMILIES",
     "Span",

@@ -420,10 +420,11 @@ class WindowedRate:
 
     ``add`` is the hot path (once per emitted token): one clock read, one
     modulo, one locked add. ``rate`` sums buckets stamped within the
-    window and divides by the window length, so it decays to 0 within
-    ``window_s`` of traffic stopping (the lifetime average never does).
-    During the first partial window after a cold start it under-reports
-    proportionally — acceptable for a freshness gauge."""
+    window and divides by the *covered* window length — ``min(window,
+    elapsed since the first add)`` — so a cold start no longer
+    under-reports by dividing a partial window's count by the full
+    window (ISSUE 9 satellite); it still decays to 0 within ``window_s``
+    of traffic stopping (the lifetime average never does)."""
 
     def __init__(self, window_s: float = 10.0, clock=time.monotonic):
         self.window = max(1, int(window_s))
@@ -431,27 +432,35 @@ class WindowedRate:
         self._n = self.window + 1  # +1: current partial second
         self._counts = [0.0] * self._n
         self._stamps = [-1] * self._n
+        self._first: Optional[float] = None  # clock time of the first add
         self._lock = threading.Lock()
 
     def add(self, n: float = 1.0) -> None:
-        t = int(self._clock())
+        now = self._clock()
+        t = int(now)
         i = t % self._n
         with self._lock:
+            if self._first is None:
+                self._first = now
             if self._stamps[i] != t:
                 self._stamps[i] = t
                 self._counts[i] = 0.0
             self._counts[i] += n
 
     def rate(self) -> float:
-        t = int(self._clock())
+        now = self._clock()
+        t = int(now)
         lo = t - self.window
         with self._lock:
+            if self._first is None:
+                return 0.0
             total = sum(
                 c
                 for c, s in zip(self._counts, self._stamps)
                 if lo < s <= t
             )
-        return total / self.window
+            covered = min(float(self.window), max(1.0, now - self._first))
+        return total / covered
 
 
 # -- process-wide default registry ------------------------------------------

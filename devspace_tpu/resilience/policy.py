@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from ..obs import events as _events
 from ..obs.metrics import get_registry
 
 # (name, kind, help) — lintable catalog (scripts/metrics_lint.py). These
@@ -139,6 +140,10 @@ class RetryPolicy:
                 break
             if self.deadline is not None and clock() - start + delay > self.deadline:
                 _retries_exhausted.inc()
+                _events.emit(
+                    "resilience", "retries_exhausted", level="error",
+                    what=describe, attempts=attempt, why="deadline",
+                )
                 if reraise:
                     raise last
                 raise RetryExhausted(
@@ -152,6 +157,10 @@ class RetryPolicy:
             _retry_attempts.inc()
             sleep(delay)
         _retries_exhausted.inc()
+        _events.emit(
+            "resilience", "retries_exhausted", level="error",
+            what=describe, attempts=self.max_attempts, why="attempts",
+        )
         if reraise:
             raise last
         raise RetryExhausted(
@@ -231,8 +240,13 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was = self._state
             self._failures = 0
             self._state = self.CLOSED
+        if was != self.CLOSED:
+            _events.emit(
+                "resilience", "circuit_close", circuit=self.name or "",
+            )
 
     def record_failure(self) -> None:
         with self._lock:
@@ -242,12 +256,21 @@ class CircuitBreaker:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 _circuit_open.inc()
+                _events.emit(
+                    "resilience", "circuit_open", level="error",
+                    circuit=self.name or "", probe_failed=True,
+                )
                 return
             self._failures += 1
             if self._failures >= self.failure_threshold:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 _circuit_open.inc()
+                _events.emit(
+                    "resilience", "circuit_open", level="error",
+                    circuit=self.name or "",
+                    failures=self._failures,
+                )
 
     def call(self, fn: Callable, *args, **kwargs):
         """Run ``fn`` under the breaker; raises :class:`CircuitOpenError`

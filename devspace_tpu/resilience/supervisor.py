@@ -36,6 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
 
+from ..obs import events as _obs_events
 from ..utils import log as logutil
 from .policy import RetryPolicy
 
@@ -207,6 +208,12 @@ class SessionSupervisor:
         """Start every registered service, then the monitor thread. A
         factory that raises during initial start propagates — startup
         failures are loud; only steady-state deaths are supervised."""
+        # capture the starting thread's trace context: the monitor thread
+        # emits from outside any request/session span stack, and its
+        # structured events should land on the session trace
+        from ..obs.tracing import get_tracer
+
+        self._trace_ctx = get_tracer().current_context()
         with self._lock:
             services = list(self._services)
         for svc in services:
@@ -311,6 +318,18 @@ class SessionSupervisor:
         with self._lock:
             self.events.append(ev)
             del self.events[:-200]  # bounded history
+        ctx = getattr(self, "_trace_ctx", None)
+        _obs_events.emit(
+            "supervisor", kind,
+            level=(
+                "error" if kind in ("died", "failed")
+                else "warn" if kind in ("restarting", "degraded")
+                else "info"
+            ),
+            trace_id=ctx.trace_id if ctx is not None else None,
+            span_id=ctx.span_id if ctx is not None else None,
+            service=service, detail=detail,
+        )
         if kind in ("died", "degraded", "failed"):
             self.log.warn("[supervisor] %s %s %s", service, kind, detail)
         elif kind in ("restarted",):

@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import events as _events
 from ..resilience.policy import RetryPolicy
 from ..utils import log as logutil
 from ..utils.ignoreutil import IgnoreMatcher
@@ -633,6 +634,13 @@ class SyncSession:
             getattr(self.workers[i], "name", i),
             exc,
         )
+        ctx = getattr(self, "_session_ctx", None)
+        _events.emit(
+            "sync", "worker_quarantined", level="error",
+            trace_id=ctx.trace_id if ctx is not None else None,
+            span_id=ctx.span_id if ctx is not None else None,
+            worker=str(getattr(self.workers[i], "name", i)), error=str(exc),
+        )
         self._publish_status()
 
     def _try_revive(self, i: int) -> bool:
@@ -693,6 +701,14 @@ class SyncSession:
                 "[sync] worker %s shell revived (%d file(s) caught up)",
                 getattr(worker, "name", i),
                 len(need),
+            )
+            ctx = getattr(self, "_session_ctx", None)
+            _events.emit(
+                "sync", "worker_revived",
+                trace_id=ctx.trace_id if ctx is not None else None,
+                span_id=ctx.span_id if ctx is not None else None,
+                worker=str(getattr(worker, "name", i)),
+                caught_up_files=len(need),
             )
             return True
         except Exception:  # noqa: BLE001 — revive is best-effort

@@ -9,11 +9,9 @@ spinner instead of goroutine animation.
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import threading
-import time
 from typing import IO, Iterable, Optional
 
 # ANSI styles (applied only when the stream is a TTY).
@@ -149,29 +147,43 @@ class FileLogger(Logger):
     """JSON-lines file logger (reference: logrus JSON to
     .devspace/logs/<name>.log, pkg/util/log/file_logger.go). Oversized
     logs are rotated to ``<path>.old`` on open (reference: sync.log
-    rotation, pkg/devspace/sync/util.go:305-340)."""
+    rotation, pkg/devspace/sync/util.go:305-340).
+
+    Rebuilt (ISSUE 9) on the structured-event pipeline: every line is an
+    :class:`devspace_tpu.obs.events.Event` serialized by the shared
+    ``JsonlSink`` — same ``{"time", "level", "msg"}`` keys as before
+    (scrapers like ``status sync`` keep working) plus ``subsystem``/
+    ``event``/``trace_id`` so a CLI log line written inside a traced
+    operation cross-references the span that produced it. Each line is
+    also published on the process event bus, so an attached
+    FlightRecorder sees CLI logs interleaved with engine events."""
 
     MAX_BYTES = 10 * 1024 * 1024
 
     def __init__(self, path: str, level: str = "debug"):
         super().__init__(level)
+        from ..obs import events as _events  # lazy: log is imported early
+
+        self._events = _events
         self.path = path
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        try:
-            if os.path.getsize(path) > self.MAX_BYTES:
-                os.replace(path, path + ".old")
-        except OSError:
-            pass
-        self._fh = open(path, "a", encoding="utf-8")
+        stem = os.path.splitext(os.path.basename(path))[0]
+        self._logger_name = stem or "default"
+        self._sink = _events.JsonlSink(path, max_bytes=self.MAX_BYTES)
 
     def _write(self, tag: str, msg: str) -> None:
-        self._fh.write(
-            json.dumps({"time": time.time(), "level": tag, "msg": msg}) + "\n"
+        ev = self._events.make_event(
+            "cli", "log", level=tag,
+            attrs={"msg": msg, "logger": self._logger_name},
         )
-        self._fh.flush()
+        self._sink.record(ev)
+        self._events.get_bus().publish(ev)
+
+    @property
+    def closed(self) -> bool:
+        return self._sink.closed
 
     def close(self) -> None:
-        self._fh.close()
+        self._sink.close()
 
 
 class DiscardLogger(Logger):
@@ -197,7 +209,7 @@ def get_file_logger(name: str, root: str = ".devspace") -> FileLogger:
     reference: pkg/util/log/file_logger.go GetFileLogger."""
     path = os.path.join(root, "logs", name + ".log")
     fl = _file_loggers.get(path)
-    if fl is None or fl._fh.closed:
+    if fl is None or fl.closed:
         fl = FileLogger(path)
         _file_loggers[path] = fl
     return fl

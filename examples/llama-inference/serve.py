@@ -3,7 +3,12 @@ TPU pod, reached through `devspace-tpu dev`'s port-forward and health-checked
 by `devspace-tpu analyze`.
 
 Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
-optional "temperature", "eos_id", "top_k", "top_p"}), /healthz, /metrics
+optional "temperature", "eos_id", "top_k", "top_p"}), /healthz (now with
+an "slo" block: multi-window burn-rate statuses per objective),
+/readyz (503 while any SLO is in breach — the load-shed hook),
+/debug/events (flight-recorder dump of recent structured events;
+?subsystem=engine&limit=N), /debug/config (effective serving config,
+the `debug bundle` member), /metrics
 (Prometheus text exposition; OpenMetrics with exemplars when the client
 Accepts application/openmetrics-text), /debug/requests (recent
 per-request serving traces; ?limit=N caps rows, ?outcome=completed|
@@ -32,12 +37,16 @@ DEVSPACE_KV_TIER env var is the fallback when the flag is omitted.
 
 import json
 import os
+import threading
 import time
 
 import jax
 
 from devspace_tpu.inference import InferenceEngine
 from devspace_tpu.models import transformer as tfm
+from devspace_tpu.obs import events as obs_events
+from devspace_tpu.obs import get_registry
+from devspace_tpu.obs import slo as obs_slo
 
 CONFIGS = {"tiny": tfm.TINY, "llama2-7b": tfm.LLAMA2_7B, "llama2-13b": tfm.LLAMA2_13B}
 
@@ -49,6 +58,8 @@ class SpecDisabled(RuntimeError):
 class Server:
     def __init__(self, kv_tier=None):
         name = os.environ.get("MODEL", "tiny")
+        self.model_name = name
+        self.kv_tier_mode = kv_tier
         self.cfg = CONFIGS[name]
         print(f"loading {name} ({self.cfg.n_layers} layers) on {jax.devices()[0]}")
         # CHECKPOINT=<dir> restores trained weights (a training root of
@@ -154,6 +165,72 @@ class Server:
                 f"prewarmed {len(timings)} programs in {time.time() - t0:.1f}s"
             )
         self.engine.start()
+        # structured events + SLO evaluation (ISSUE 9): a FlightRecorder
+        # on the process bus keeps the last N events per subsystem for
+        # /debug/events and `devspace-tpu debug bundle`; the SLO
+        # evaluator runs burn-rate math over the engine + default
+        # registries on a background thread and feeds /healthz, /readyz
+        # and `devspace-tpu status serving`. DEVSPACE_ENGINE_EVENTS=off
+        # detaches the recorder (the emit sites then cost one branch).
+        self.flight = None
+        if obs_events.events_enabled():
+            self.flight = obs_events.add_sink(obs_events.FlightRecorder(
+                per_subsystem=int(os.environ.get("DEVSPACE_EVENT_RING", 256))
+            ))
+        specs = obs_slo.default_serving_slos(
+            ttft_threshold_s=float(
+                os.environ.get("DEVSPACE_SLO_TTFT_P99_S", 1.0)
+            ),
+            tok_s_floor=float(
+                os.environ.get("DEVSPACE_SLO_TOK_S_FLOOR", 0.5)
+            ),
+            short_window_s=float(
+                os.environ.get("DEVSPACE_SLO_SHORT_WINDOW_S", 300.0)
+            ),
+            long_window_s=float(
+                os.environ.get("DEVSPACE_SLO_LONG_WINDOW_S", 3600.0)
+            ),
+        )
+        sources = []
+        if self.engine.metrics_registry is not None:
+            sources.append(self.engine.metrics_registry.snapshot)
+        sources.append(get_registry().snapshot)
+        self.slo = obs_slo.SLOEvaluator(specs, sources)
+        self.slo.register_metrics(get_registry())
+        self.slo_interval = float(os.environ.get("DEVSPACE_SLO_INTERVAL_S", 5.0))
+        threading.Thread(
+            target=self._slo_loop, daemon=True, name="slo-eval"
+        ).start()
+
+    def _slo_loop(self):
+        while True:
+            time.sleep(self.slo_interval)
+            try:
+                self.slo.evaluate()
+            except Exception:  # noqa: BLE001 — evaluation must not die
+                pass
+
+    def config(self):
+        """Effective serving configuration — the `config.json` member of
+        `devspace-tpu debug bundle` (incident triage: what was this
+        server actually running?)."""
+        return {
+            "model": self.model_name,
+            "layers": self.cfg.n_layers,
+            "max_seq_len": self.cfg.max_seq_len,
+            "vocab_size": self.cfg.vocab_size,
+            "max_slots": int(os.environ.get("MAX_SLOTS", 8)),
+            "chunk_max": int(os.environ.get("CHUNK_MAX", 8)),
+            "spec_k": self.spec_k,
+            "speculative": self.engine.draft_params is not None,
+            "kv_tier": self.kv_tier_mode
+            or os.environ.get("DEVSPACE_KV_TIER", "off"),
+            "checkpoint": os.environ.get("CHECKPOINT"),
+            "quantize": os.environ.get("QUANTIZE"),
+            "events_enabled": self.flight is not None,
+            "slo_interval_s": self.slo_interval,
+            "slos": [s.to_dict() for s in self.slo.specs],
+        }
 
     def generate_speculative(
         self, prompt_ids, max_new_tokens, k=None, traceparent=None
@@ -263,9 +340,42 @@ def main(argv=None):
                     {
                         "ok": True,
                         "model": os.environ.get("MODEL", "tiny"),
+                        "slo": server.slo.to_dict(),
                         **server.engine.stats(),
                     },
                 )
+            elif path == "/readyz":
+                # the load-shed signal: not-ready while any SLO is in
+                # breach (multi-window burn rate, obs/slo.py) — a probe
+                # or LB can stop routing here without killing the pod
+                # (liveness stays /healthz)
+                slo = server.slo.to_dict()
+                code = 200 if slo["ready"] else 503
+                self._json(code, {"ready": slo["ready"], "slo": slo})
+            elif path == "/debug/events":
+                # flight-recorder dump: ?subsystem=engine limits to one
+                # ring, ?limit=N keeps the newest N (oldest first)
+                try:
+                    limit = int(qs.get("limit", ["200"])[0])
+                except ValueError:
+                    self._json(400, {"error": "limit must be an integer"})
+                    return
+                subsystem = qs.get("subsystem", [None])[0]
+                fr = server.flight
+                self._json(
+                    200,
+                    {
+                        "events_enabled": fr is not None,
+                        "subsystems": fr.subsystems() if fr is not None else [],
+                        "events": (
+                            fr.dump_dicts(subsystem, limit)
+                            if fr is not None
+                            else []
+                        ),
+                    },
+                )
+            elif path == "/debug/config":
+                self._json(200, server.config())
             elif path == "/metrics":
                 # Prometheus text exposition: the engine's private
                 # registry (serving histograms + engine gauges) plus the

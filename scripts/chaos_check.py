@@ -48,6 +48,12 @@ REQUIRED_CHAOS_MODULES = (
     # a retry must re-attach the originating trace; a dropped worker's
     # upload span must close with outcome=failed
     "test_obs_tracing",
+    # structured-event capture under injected failures (ISSUE 9): a
+    # poisoned dispatch window must dump flight-recorder events carrying
+    # the failing request's trace id; a supervisor restart under an
+    # injected fault must emit restart/degraded events on the session
+    # trace
+    "test_obs_events",
 )
 
 

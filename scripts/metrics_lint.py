@@ -40,6 +40,8 @@ _UNITLESS_GAUGE_SUFFIXES = (
     "_requests",
     "_depth",
     "_occupancy",
+    "_status",
+    "_ratio",
 )
 _RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
 
@@ -48,7 +50,9 @@ def load_catalogs() -> dict[str, tuple]:
     """{catalog label: ((name, kind, help, *rest), ...)} — import order
     matters only for jax (engine); everything else is dependency-free."""
     from devspace_tpu.inference.engine import ENGINE_METRIC_FAMILIES
+    from devspace_tpu.obs.events import EVENTS_METRIC_FAMILIES
     from devspace_tpu.obs.request_trace import SERVING_METRIC_FAMILIES
+    from devspace_tpu.obs.slo import SLO_METRIC_FAMILIES
     from devspace_tpu.obs.tracing import TRACING_METRIC_FAMILIES
     from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
     from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
@@ -61,6 +65,8 @@ def load_catalogs() -> dict[str, tuple]:
         "resilience": RESILIENCE_METRIC_FAMILIES,
         "trace": TRACE_METRIC_FAMILIES,
         "tracing": TRACING_METRIC_FAMILIES,
+        "events": EVENTS_METRIC_FAMILIES,
+        "slo": SLO_METRIC_FAMILIES,
     }
 
 
@@ -141,20 +147,39 @@ def check_timeline_tracks() -> list[str]:
     return tracing.lint_tracks()
 
 
+def check_event_catalog() -> tuple[list[str], int]:
+    """Structured-event catalog lint (obs/events.py): names snake_case,
+    subsystems known, (subsystem, name) pairs unique, help nonempty — so
+    a misspelled event can't ship and dashboards grep one stable set."""
+    from devspace_tpu.obs import events
+
+    return (
+        [f"events:{p}" for p in events.lint_catalog()],
+        len(events.EVENT_CATALOG),
+    )
+
+
 def main() -> int:
     catalogs = load_catalogs()
+    event_problems, n_events = check_event_catalog()
     problems = (
-        lint(catalogs) + check_registrable(catalogs) + check_timeline_tracks()
+        lint(catalogs)
+        + check_registrable(catalogs)
+        + check_timeline_tracks()
+        + event_problems
     )
     n = sum(len(f) for f in catalogs.values())
     for p in problems:
         print(f"ERROR {p}")
     if problems:
-        print(f"{len(problems)} problem(s) across {n} metric families")
+        print(
+            f"{len(problems)} problem(s) across {n} metric families "
+            f"and {n_events} event names"
+        )
         return 1
     print(
         f"ok: {n} metric families across {len(catalogs)} catalogs; "
-        "timeline track names unique"
+        f"{n_events} event names in catalog; timeline track names unique"
     )
     return 0
 

@@ -1,0 +1,254 @@
+"""Incident tooling CLI tests (``top`` + ``debug bundle`` — ISSUE 9).
+
+Runs both commands against a stub ``http.server`` serving canned
+``/metrics`` / ``/healthz`` / ``/debug/*`` payloads — no engine, no
+sleeps — pinning the Prometheus text parsing, the dashboard frame
+layout, the bundle tar structure and the partial-failure manifest.
+The live-server end-to-end pass (readyz flip, real flight-recorder
+events) is the slow-marked test in test_serving_example.py.
+"""
+
+import io
+import json
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from devspace_tpu.cli.main import (
+    _human_bytes,
+    _parse_prom_text,
+    _prom_value,
+    main,
+)
+from devspace_tpu.utils import log as logutil
+
+TRACE = "ab" * 16
+
+METRICS_TEXT = """\
+# HELP engine_tokens_per_sec_10s Tokens per second.
+# TYPE engine_tokens_per_sec_10s gauge
+engine_tokens_per_sec_10s 42.5
+engine_active_slots 3
+engine_max_slots 4
+engine_queued_requests 1
+engine_prefilling_slots 1
+engine_free_kv_blocks 10
+engine_kv_blocks 64
+engine_dispatch_depth_occupancy 1.71
+engine_kv_tier_resident_bytes 1048576
+engine_kv_spill_blocks_total 12
+engine_requests_completed_total 100
+engine_requests_failed_total 2
+slo_status{slo="ttft_p99"} 2
+slo_burn_ratio{slo="ttft_p99",window="short"} 8.0
+"""
+
+HEALTHZ = {
+    "status": "ok",
+    "slo": {
+        "ready": False,
+        "status": "breach",
+        "slos": [
+            {"name": "ttft_p99", "status": "breach",
+             "burn_short": 8.0, "burn_long": 8.0},
+            {"name": "error_rate", "status": "ok",
+             "burn_short": 0.1, "burn_long": 0.2},
+        ],
+    },
+}
+
+EVENTS = {
+    "events_enabled": True,
+    "subsystems": ["engine"],
+    "events": [
+        {"time": 1754500000.0, "level": "error", "subsystem": "engine",
+         "event": "request_failed", "trace_id": TRACE,
+         "reason": "decode failed"},
+    ],
+}
+
+REQUESTS = {"requests": [{"id": 1, "trace_id": TRACE, "outcome": "failed"}]}
+
+CONFIG = {"model": "tiny", "max_slots": 4, "events_enabled": True}
+
+
+class StubHandler(BaseHTTPRequestHandler):
+    omit = ()  # paths to 404 (set per-server)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        payloads = {
+            "/metrics": ("text/plain", METRICS_TEXT.encode()),
+            "/healthz": ("application/json", json.dumps(HEALTHZ).encode()),
+            "/debug/events": ("application/json", json.dumps(EVENTS).encode()),
+            "/debug/requests": (
+                "application/json", json.dumps(REQUESTS).encode()),
+            "/debug/config": ("application/json", json.dumps(CONFIG).encode()),
+        }
+        if path in self.omit or path not in payloads:
+            self.send_error(404)
+            return
+        ctype, body = payloads[path]
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def stub_url():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), StubHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+class _DynStream:
+    """Resolves sys.stdout at write time so the logger always hits the
+    stream capsys has installed for the current test."""
+
+    def write(self, s):
+        import sys
+
+        return sys.stdout.write(s)
+
+    def flush(self):
+        import sys
+
+        sys.stdout.flush()
+
+    def isatty(self):
+        return False
+
+
+@pytest.fixture(autouse=True)
+def stdout_logger():
+    logutil.set_logger(logutil.StdoutLogger(stream=_DynStream()))
+
+
+# -- parsing helpers ---------------------------------------------------------
+def test_parse_prom_text():
+    fams = _parse_prom_text(METRICS_TEXT)
+    assert fams["engine_tokens_per_sec_10s"] == [({}, 42.5)]
+    assert fams["slo_status"] == [({"slo": "ttft_p99"}, 2.0)]
+    assert fams["slo_burn_ratio"] == [
+        ({"slo": "ttft_p99", "window": "short"}, 8.0)
+    ]
+    assert _prom_value(fams, "engine_requests_completed_total") == 100.0
+    assert _prom_value(fams, "missing_family", default=None) is None
+    # comment lines, blank lines and non-numeric values are skipped
+    assert "# HELP" not in str(fams)
+
+
+def test_human_bytes():
+    assert _human_bytes(None) == "-"
+    assert _human_bytes(512) == "512B"
+    assert _human_bytes(2048) == "2.0KiB"
+    assert _human_bytes(1048576) == "1.0MiB"
+    assert _human_bytes(3 * 1024**3) == "3.0GiB"
+
+
+# -- top ---------------------------------------------------------------------
+def test_top_renders_one_frame(stub_url, capsys):
+    assert main(["top", "--url", stub_url, "--iterations", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "devspace-tpu top" in out
+    assert "42.5" in out  # tok/s
+    assert "3/4" in out  # active/max slots
+    assert "10/64" in out  # free/total kv blocks
+    assert "1.0MiB" in out  # tier-resident bytes humanized
+    assert "ttft_p99" in out and "breach" in out
+    assert "NOT READY" in out  # ready: false in the canned healthz
+    assert "RECENT EVENTS" in out
+    assert "engine.request_failed" in out
+    assert "reason=decode failed" in out
+    assert "span_id" not in out  # noise keys pruned from the event line
+
+
+def test_top_survives_missing_events_endpoint(stub_url, capsys, monkeypatch):
+    monkeypatch.setattr(StubHandler, "omit", ("/debug/events",))
+    assert main(["top", "--url", stub_url, "--iterations", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "42.5" in out  # dashboard still renders without events
+    assert "RECENT EVENTS" not in out
+
+
+def test_top_unreachable_server_fails(capsys):
+    assert main(["top", "--url", "http://127.0.0.1:9", "--iterations", "1"]) == 1
+    assert "no serving endpoint" in capsys.readouterr().out
+
+
+# -- debug bundle ------------------------------------------------------------
+def test_debug_bundle_writes_tar(stub_url, tmp_path):
+    out = str(tmp_path / "incident.tar.gz")
+    rc = main([
+        "debug", "bundle", "--url", stub_url, "--out", out, "--seconds", "0",
+    ])
+    assert rc == 0
+    with tarfile.open(out, "r:gz") as tar:
+        names = sorted(tar.getnames())
+        assert names == [
+            "bundle/config.json",
+            "bundle/events.json",
+            "bundle/healthz.json",
+            "bundle/manifest.json",
+            "bundle/metrics.txt",
+            "bundle/requests.json",
+        ]
+        manifest = json.load(tar.extractfile("bundle/manifest.json"))
+        assert manifest["url"] == stub_url
+        assert manifest["errors"] == {}
+        assert sorted(manifest["members"]) == [
+            "config.json", "events.json", "healthz.json",
+            "metrics.txt", "requests.json",
+        ]
+        events = json.load(tar.extractfile("bundle/events.json"))
+        requests = json.load(tar.extractfile("bundle/requests.json"))
+        # flight-recorder events cross-reference the request traces
+        ev_traces = {e["trace_id"] for e in events["events"] if "trace_id" in e}
+        req_traces = {r["trace_id"] for r in requests["requests"]}
+        assert ev_traces & req_traces == {TRACE}
+        metrics = tar.extractfile("bundle/metrics.txt").read().decode()
+        assert "engine_tokens_per_sec_10s 42.5" in metrics
+
+
+def test_debug_bundle_partial_failure_recorded(stub_url, tmp_path, monkeypatch):
+    monkeypatch.setattr(StubHandler, "omit", ("/debug/events",))
+    out = str(tmp_path / "partial.tar.gz")
+    rc = main([
+        "debug", "bundle", "--url", stub_url, "--out", out, "--seconds", "0",
+    ])
+    assert rc == 0  # partial evidence beats none
+    with tarfile.open(out, "r:gz") as tar:
+        names = tar.getnames()
+        assert "bundle/events.json" not in names
+        assert "bundle/metrics.txt" in names
+        manifest = json.load(tar.extractfile("bundle/manifest.json"))
+        assert list(manifest["errors"]) == ["events.json"]
+
+
+def test_debug_bundle_rejects_bad_seconds(stub_url, tmp_path):
+    rc = main([
+        "debug", "bundle", "--url", stub_url,
+        "--out", str(tmp_path / "x.tar.gz"), "--seconds", "999",
+    ])
+    assert rc == 1
+
+
+def test_debug_bundle_no_server_fails(tmp_path):
+    rc = main([
+        "debug", "bundle", "--url", "http://127.0.0.1:9",
+        "--out", str(tmp_path / "x.tar.gz"), "--seconds", "0",
+    ])
+    assert rc == 1
+    assert not (tmp_path / "x.tar.gz").exists()

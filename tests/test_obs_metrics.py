@@ -208,11 +208,42 @@ def test_windowed_rate_decays_where_lifetime_average_lies():
         clock["t"] = float(s)
         r.add(5)
     clock["t"] = 9.0
-    assert r.rate() == pytest.approx(5.0)  # 50 events over the last 10s
+    # 50 events over the 9s actually covered so far (the cold-start fix:
+    # the divisor is the covered window, not the full 10s)
+    assert r.rate() == pytest.approx(50 / 9)
     clock["t"] = 25.0  # 16s of silence: every bucket is stale
     assert r.rate() == 0.0
     r.add(10)
     assert r.rate() == pytest.approx(1.0)  # 10 events / 10s window
+
+
+def test_windowed_rate_cold_start_uses_covered_window():
+    """ISSUE 9 satellite regression: in the first seconds of traffic the
+    denominator is the elapsed (covered) window, not the full window —
+    a server 2s into serving 5 tok/s must report ~5, not 1."""
+    clock = {"t": 100.0}
+    r = WindowedRate(10.0, clock=lambda: clock["t"])
+    assert r.rate() == 0.0  # no adds yet: no covered window, no rate
+    r.add(5)
+    clock["t"] = 101.0
+    r.add(5)
+    clock["t"] = 102.0
+    # 10 events over 2 covered seconds — the old code said 10/10 = 1.0
+    assert r.rate() == pytest.approx(5.0)
+    # sub-second cold start clamps the divisor to 1s, never explodes
+    clock["t"] = 200.0
+    r2 = WindowedRate(10.0, clock=lambda: clock["t"])
+    r2.add(3)
+    clock["t"] = 200.1
+    assert r2.rate() == pytest.approx(3.0)
+    # steady state is unchanged: after the window fills, divide by window
+    clock["t"] = 300.0
+    r3 = WindowedRate(10.0, clock=lambda: clock["t"])
+    for s in range(20):
+        clock["t"] = 300.0 + s
+        r3.add(2)
+    clock["t"] = 319.5
+    assert r3.rate() == pytest.approx(2.0)
 
 
 def test_windowed_rate_bucket_reuse_after_wrap():

@@ -1,0 +1,366 @@
+"""SLO burn-rate engine goldens (obs/slo.py — ISSUE 9).
+
+Every number here is hand-computed from the definitions: bad-fraction
+over a window divided by the error budget (1 - objective) gives the
+burn rate; a spec breaches only when BOTH windows burn above
+``breach_burn``, warns when both exceed ``warn_burn``. The evaluator
+runs under a fake clock against hand-built ``Registry.snapshot``-shaped
+dicts, so each window's baseline entry is known exactly.
+"""
+
+import math
+
+import pytest
+
+from devspace_tpu.obs.events import EventBus
+from devspace_tpu.obs.metrics import Registry
+from devspace_tpu.obs.slo import (
+    SLO_METRIC_FAMILIES,
+    SLOEvaluator,
+    SLOSpec,
+    default_serving_slos,
+)
+
+
+def counter_fam(value):
+    return {"kind": "counter", "help": "h", "samples": [({}, float(value))]}
+
+
+def gauge_fam(value):
+    return {"kind": "gauge", "help": "h", "samples": [({}, float(value))]}
+
+
+def hist_fam(good, total, threshold=1.0):
+    """Histogram family where ``good`` observations landed at or below
+    ``threshold`` and the rest above it."""
+    return {
+        "kind": "histogram",
+        "help": "h",
+        "samples": [
+            ({}, {
+                "buckets": [(threshold, float(good)), (math.inf, float(total))],
+                "count": float(total),
+                "sum": 0.0,
+            })
+        ],
+    }
+
+
+class FakeSource:
+    def __init__(self, snap=None):
+        self.snap = snap or {}
+
+    def __call__(self):
+        return self.snap
+
+
+def make_eval(spec, source, clock, bus=None):
+    return SLOEvaluator([spec], [source], clock=lambda: clock["t"], bus=bus)
+
+
+# -- spec validation ---------------------------------------------------------
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        SLOSpec(name="x", kind="vibes", objective=0.9)
+    with pytest.raises(ValueError, match="objective"):
+        SLOSpec(name="x", kind="error_rate", objective=1.0,
+                bad=("b",), total=("t",))
+    with pytest.raises(ValueError, match="histogram"):
+        SLOSpec(name="x", kind="latency", objective=0.9)
+    with pytest.raises(ValueError, match="bad"):
+        SLOSpec(name="x", kind="error_rate", objective=0.9)
+    with pytest.raises(ValueError, match="gauge"):
+        SLOSpec(name="x", kind="throughput_floor", objective=0.9)
+    with pytest.raises(ValueError, match="window"):
+        SLOSpec(name="x", kind="error_rate", objective=0.9, bad=("b",),
+                total=("t",), short_window_s=600, long_window_s=300)
+    with pytest.raises(ValueError, match="duplicate"):
+        specs = [
+            SLOSpec(name="dup", kind="error_rate", objective=0.9,
+                    bad=("b",), total=("t",))
+        ] * 2
+        SLOEvaluator(specs, [dict])
+    # budget floor guards div-by-zero for extreme objectives
+    s = SLOSpec(name="x", kind="error_rate", objective=0.99,
+                bad=("b",), total=("t",))
+    assert s.budget == pytest.approx(0.01)
+
+
+# -- error-rate golden -------------------------------------------------------
+def test_error_rate_burn_golden_and_recovery():
+    """objective 0.99 (budget 0.01). 8 failures in 100 requests inside
+    both windows -> bad_frac 0.08 -> burn 8.0 on both -> breach. Freeze
+    the counters and slide the short window past the incident: short
+    burn 0, long burn still 8 -> min gates back to ok (recovered)."""
+    spec = SLOSpec(
+        name="error_rate", kind="error_rate", objective=0.99,
+        bad=("requests_failed_total",),
+        total=("requests_failed_total", "requests_completed_total"),
+        short_window_s=300, long_window_s=3600,
+    )
+    src = FakeSource({
+        "requests_failed_total": counter_fam(0),
+        "requests_completed_total": counter_fam(0),
+    })
+    clock = {"t": 0.0}
+    bus = EventBus()
+    seen = []
+
+    class Sink:
+        def record(self, ev):
+            seen.append(ev)
+
+    bus.add_sink(Sink())
+    ev = make_eval(spec, src, clock, bus=bus)
+    assert ev.ready() is True  # before any evaluation: never block startup
+    (st,) = ev.evaluate()
+    assert st.status == "ok" and st.burn_short == 0.0
+
+    clock["t"] = 60.0
+    src.snap = {
+        "requests_failed_total": counter_fam(8),
+        "requests_completed_total": counter_fam(92),
+    }
+    (st,) = ev.evaluate()
+    # delta vs the t=0 baseline: 8 bad / 100 total = 0.08; 0.08/0.01 = 8
+    assert st.status == "breach"
+    assert st.burn_short == pytest.approx(8.0)
+    assert st.burn_long == pytest.approx(8.0)
+    assert st.bad_short == 8.0 and st.total_short == 100.0
+    assert ev.ready() is False
+    assert ev.worst() == "breach"
+    assert [e.name for e in seen] == ["breach"]
+    assert seen[-1].attrs["was"] == "ok"
+
+    # 301s later with frozen counters the short baseline is the t=60
+    # entry (delta 0) while the long baseline is still t=0 (burn 8):
+    # min(0, 8) = 0 -> ok, and /readyz recovers
+    clock["t"] = 361.0
+    (st,) = ev.evaluate()
+    assert st.status == "ok"
+    assert st.burn_short == pytest.approx(0.0)
+    assert st.burn_long == pytest.approx(8.0)
+    assert ev.ready() is True
+    assert [e.name for e in seen] == ["breach", "recovered"]
+    assert seen[-1].attrs["was"] == "breach"
+
+
+def test_error_rate_warn_band():
+    """3 failures in 100 -> burn ~3.0: above warn (1.0), below breach
+    (6.0) on both windows -> warn."""
+    spec = SLOSpec(
+        name="er", kind="error_rate", objective=0.99,
+        bad=("bad_total",), total=("all_total",),
+        short_window_s=300, long_window_s=3600,
+    )
+    src = FakeSource({"bad_total": counter_fam(0), "all_total": counter_fam(0)})
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    ev.evaluate()
+    clock["t"] = 30.0
+    src.snap = {"bad_total": counter_fam(3), "all_total": counter_fam(100)}
+    (st,) = ev.evaluate()
+    assert st.status == "warn"
+    assert st.burn_short == pytest.approx(3.0, rel=1e-6)
+
+
+def test_min_events_guard_no_data_is_ok():
+    spec = SLOSpec(
+        name="er", kind="error_rate", objective=0.99,
+        bad=("bad_total",), total=("all_total",), min_events=10,
+    )
+    src = FakeSource({"bad_total": counter_fam(0), "all_total": counter_fam(0)})
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    ev.evaluate()
+    clock["t"] = 30.0
+    # 2 of 5 failed would be a 40x burn — but 5 < min_events: no data
+    src.snap = {"bad_total": counter_fam(2), "all_total": counter_fam(5)}
+    (st,) = ev.evaluate()
+    assert st.status == "ok" and st.burn_short == 0.0
+    assert st.total_short == 5.0
+
+
+# -- latency golden ----------------------------------------------------------
+def test_latency_burn_from_histogram_buckets():
+    """p99 TTFT at threshold 1.0s, objective 0.99: 95 of 100 in-bucket
+    -> bad_frac 0.05 -> burn 5.0 -> warn (both windows, 1.0 <= 5 < 6).
+    Then 20 more all bad: window delta 25 bad / 120 total... but
+    hand-compute the SHORT window against its own baseline."""
+    spec = SLOSpec(
+        name="ttft_p99", kind="latency", objective=0.99,
+        histogram="ttft_seconds", threshold_s=1.0,
+        short_window_s=300, long_window_s=3600,
+    )
+    src = FakeSource({"ttft_seconds": hist_fam(0, 0)})
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    ev.evaluate()
+    clock["t"] = 60.0
+    src.snap = {"ttft_seconds": hist_fam(95, 100)}
+    (st,) = ev.evaluate()
+    # 5 above-threshold of 100 = 0.05; burn 0.05/0.01 = 5 -> warn
+    assert st.status == "warn"
+    assert st.burn_short == pytest.approx(5.0, rel=1e-6)
+    assert st.bad_short == 5.0 and st.total_short == 100.0
+    clock["t"] = 120.0
+    src.snap = {"ttft_seconds": hist_fam(95, 120)}
+    (st,) = ev.evaluate()
+    # short baseline is t=0 (<= 120-300 has no entry, falls to oldest):
+    # 25 bad / 120 total = 0.2083 -> burn 20.8 -> breach on both windows
+    assert st.status == "breach"
+    assert st.burn_short == pytest.approx(25 / 120 / 0.01, rel=1e-3)
+
+
+def test_latency_threshold_snaps_to_bucket_edge():
+    """threshold 0.8 with edges (1.0, inf): goodness is read at the 1.0
+    edge (documented bucket-resolution behavior)."""
+    spec = SLOSpec(
+        name="lat", kind="latency", objective=0.9,
+        histogram="h_seconds", threshold_s=0.8,
+    )
+    src = FakeSource({"h_seconds": hist_fam(0, 0)})
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    ev.evaluate()
+    clock["t"] = 10.0
+    src.snap = {"h_seconds": hist_fam(90, 100, threshold=1.0)}
+    (st,) = ev.evaluate()
+    assert st.bad_short == 10.0  # read at the 1.0 edge, not interpolated
+
+
+# -- throughput-floor golden -------------------------------------------------
+def test_throughput_floor_counts_only_active_samples():
+    """objective 0.9 (budget 0.1), floor 0.5 tok/s. Sample sequence
+    (value, active): idle samples are excluded; 2 of 4 active samples
+    below floor -> bad_frac 0.5 -> burn 5.0 -> warn."""
+    spec = SLOSpec(
+        name="tok_floor", kind="throughput_floor", objective=0.9,
+        gauge="tok_per_sec", floor=0.5, activity=("active_slots",),
+        short_window_s=300, long_window_s=3600,
+    )
+    src = FakeSource()
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    seq = [
+        (0.0, 0),  # idle: engine drained — not a breach sample
+        (2.0, 1),  # active, healthy
+        (0.1, 1),  # active, below floor
+        (0.2, 2),  # active, below floor
+        (1.5, 1),  # active, healthy
+    ]
+    for i, (tok, slots) in enumerate(seq):
+        clock["t"] = float(i * 10)
+        src.snap = {
+            "tok_per_sec": gauge_fam(tok),
+            "active_slots": gauge_fam(slots),
+        }
+        (st,) = ev.evaluate()
+    assert st.status == "warn"
+    assert st.burn_short == pytest.approx(5.0, rel=1e-6)
+    assert st.bad_short == 2.0 and st.total_short == 4.0
+
+
+def test_throughput_floor_all_idle_is_ok():
+    spec = SLOSpec(
+        name="tok_floor", kind="throughput_floor", objective=0.9,
+        gauge="tok_per_sec", floor=0.5, activity=("active_slots",),
+    )
+    src = FakeSource({
+        "tok_per_sec": gauge_fam(0.0), "active_slots": gauge_fam(0),
+    })
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    for i in range(5):
+        clock["t"] = float(i * 10)
+        (st,) = ev.evaluate()
+    assert st.status == "ok" and st.total_short == 0.0
+
+
+# -- evaluator plumbing ------------------------------------------------------
+def test_sources_merge_and_dead_source_degrades():
+    spec = SLOSpec(
+        name="er", kind="error_rate", objective=0.99,
+        bad=("bad_total",), total=("all_total",),
+    )
+
+    def dead():
+        raise RuntimeError("engine stopped")
+
+    srcs = [
+        dead,
+        lambda: {"bad_total": counter_fam(0)},
+        lambda: {"all_total": counter_fam(0)},
+    ]
+    clock = {"t": 0.0}
+    ev = SLOEvaluator([spec], srcs, clock=lambda: clock["t"])
+    (st,) = ev.evaluate()  # no crash; both live sources merged
+    assert st.status == "ok"
+
+
+def test_history_trims_to_horizon_keeping_long_baseline():
+    spec = SLOSpec(
+        name="er", kind="error_rate", objective=0.99,
+        bad=("b_total",), total=("t_total",),
+        short_window_s=10, long_window_s=20,
+    )
+    src = FakeSource({"b_total": counter_fam(0), "t_total": counter_fam(0)})
+    clock = {"t": 0.0}
+    ev = make_eval(spec, src, clock)
+    for i in range(100):
+        clock["t"] = float(i)
+        ev.evaluate()
+    # horizon is long_window + 1: ring stays bounded, and one entry at
+    # or beyond the long cutoff survives as the baseline
+    assert len(ev._history) <= 24
+    assert ev._history[0][0] <= clock["t"] - 20
+
+
+def test_to_dict_and_register_metrics():
+    spec = SLOSpec(
+        name="er", kind="error_rate", objective=0.99,
+        bad=("bad_total",), total=("all_total",),
+    )
+    src = FakeSource({"bad_total": counter_fam(0), "all_total": counter_fam(0)})
+    clock = {"t": 5.0}
+    ev = make_eval(spec, src, clock)
+    reg = Registry()
+    ev.register_metrics(reg)
+    d = ev.to_dict()
+    assert d["ready"] is True and d["status"] == "ok" and d["slos"] == []
+    ev.evaluate()
+    clock["t"] = 35.0
+    src.snap = {"bad_total": counter_fam(8), "all_total": counter_fam(100)}
+    ev.evaluate()
+    d = ev.to_dict()
+    assert d["ready"] is False and d["status"] == "breach"
+    assert d["evaluated_at"] == 35.0
+    assert d["slos"][0]["name"] == "er"
+    assert d["slos"][0]["burn_short"] == pytest.approx(8.0, abs=1e-3)
+    out = reg.render()
+    assert 'slo_status{slo="er"} 2' in out
+    assert 'slo_burn_ratio{slo="er",window="short"}' in out
+    assert 'slo_burn_ratio{slo="er",window="long"}' in out
+
+
+def test_default_serving_slos_shape():
+    specs = default_serving_slos(
+        ttft_threshold_s=2.0, tok_s_floor=1.0,
+        short_window_s=60, long_window_s=600,
+    )
+    by_name = {s.name: s for s in specs}
+    assert set(by_name) == {
+        "ttft_p99", "error_rate", "availability", "tok_s_floor",
+    }
+    assert by_name["ttft_p99"].threshold_s == 2.0
+    assert by_name["ttft_p99"].histogram == "ttft_seconds"
+    assert by_name["tok_s_floor"].floor == 1.0
+    assert by_name["availability"].breach_burn == 14.4
+    assert by_name["availability"].short_window_s == 600
+    # the catalog names stay in sync with the registered gauges
+    assert [f[0] for f in SLO_METRIC_FAMILIES] == [
+        "slo_status", "slo_burn_ratio",
+    ]
+    # each spec serializes for /healthz + debug bundles
+    for s in specs:
+        assert s.to_dict()["name"] == s.name

@@ -134,3 +134,151 @@ def test_serving_example_http_end_to_end():
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+@pytest.mark.slow
+def test_serving_observability_end_to_end(tmp_path):
+    """ISSUE 9 acceptance path against a live server: an injected
+    TTFT-p99 breach (microscopic threshold + 3s short window) flips
+    /readyz to 503 within one evaluation interval and recovers once the
+    short window slides past the incident; /debug/events serves
+    flight-recorder events whose trace ids cross-reference
+    /debug/requests; and `devspace-tpu debug bundle` tars it all up."""
+    import tarfile
+
+    from devspace_tpu.cli.main import main as cli_main
+
+    port = 18474
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        MODEL="tiny",
+        MAX_SLOTS="2",
+        PORT=str(port),
+        DEVSPACE_SLO_INTERVAL_S="0.2",
+        DEVSPACE_SLO_TTFT_P99_S="0.000001",  # any real TTFT breaches
+        DEVSPACE_SLO_SHORT_WINDOW_S="3",
+        DEVSPACE_SLO_LONG_WINDOW_S="3600",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, SERVE],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    base = f"http://127.0.0.1:{port}"
+
+    def get(path, timeout=60):
+        try:
+            with urllib.request.urlopen(base + path, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", port), timeout=1):
+                    break
+            except OSError:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.stdout.read()[-2000:]}")
+                time.sleep(0.3)
+        else:
+            pytest.fail(f"server never opened :{port}")
+
+        # ready before any traffic: no data is not a breach
+        code, ready = get("/readyz")
+        assert code == 200 and ready["ready"] is True
+
+        # warm-up request: compiles every serving program. Its TTFT
+        # lands mid-compile, seconds before the POST returns, so its
+        # breach may slide out of the 3s short window unobserved —
+        # wait for readyz to settle before the real probe.
+        code, g = _post(
+            base + "/generate", {"prompt_ids": [5, 1, 4], "max_new_tokens": 4}
+        )
+        assert code == 200 and len(g["tokens"]) == 4
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            code, ready = get("/readyz")
+            if code == 200:
+                break
+            time.sleep(0.2)
+        assert code == 200
+
+        # the probe: compiled now, the POST returns well inside the
+        # short window, and its TTFT (real, >> 1µs) must flip readyz
+        code, g = _post(
+            base + "/generate", {"prompt_ids": [2, 9], "max_new_tokens": 4}
+        )
+        assert code == 200 and len(g["tokens"]) == 4
+
+        # the TTFT observation lands within one 0.2s evaluation: 503
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            code, ready = get("/readyz")
+            if code == 503:
+                break
+            time.sleep(0.1)
+        assert code == 503 and ready["ready"] is False
+        breached = [
+            s for s in ready["slo"]["slos"] if s["status"] == "breach"
+        ]
+        assert any(s["name"] == "ttft_p99" for s in breached)
+        code, health = get("/healthz")
+        assert code == 200  # liveness unaffected by readiness
+        assert health["slo"]["status"] == "breach"
+
+        # grab the bundle while the incident is live
+        out = str(tmp_path / "incident.tar.gz")
+        assert cli_main(
+            ["debug", "bundle", "--url", base, "--out", out, "--seconds", "0"]
+        ) == 0
+        with tarfile.open(out, "r:gz") as tar:
+            names = set(tar.getnames())
+            assert {
+                "bundle/manifest.json", "bundle/metrics.txt",
+                "bundle/healthz.json", "bundle/config.json",
+                "bundle/requests.json", "bundle/events.json",
+            } <= names
+            events = json.load(tar.extractfile("bundle/events.json"))
+            requests = json.load(tar.extractfile("bundle/requests.json"))
+            config = json.load(tar.extractfile("bundle/config.json"))
+        assert events["events_enabled"] is True
+        assert "engine" in events["subsystems"]
+        ev_traces = {
+            e["trace_id"] for e in events["events"] if e.get("trace_id")
+        }
+        req_traces = {
+            r["trace_id"] for r in requests["requests"] if r.get("trace_id")
+        }
+        assert ev_traces & req_traces, (
+            "flight-recorder events don't cross-reference any request trace"
+        )
+        admits = [
+            e for e in events["events"]
+            if e["subsystem"] == "engine" and e["event"] == "admit"
+        ]
+        assert admits and admits[0]["trace_id"] in req_traces
+        assert config["model"] == "tiny"
+        assert config["events_enabled"] is True
+        assert any(s["name"] == "ttft_p99" for s in config["slos"])
+
+        # recovery: the 3s short window slides past the single bad TTFT
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            code, ready = get("/readyz")
+            if code == 200:
+                break
+            time.sleep(0.2)
+        assert code == 200 and ready["ready"] is True
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
