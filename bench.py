@@ -1069,6 +1069,58 @@ def bench_prefix_cache() -> tuple[float, float]:
     return mb["radix"]["match_us"], mb["radix"]["evict_us"]
 
 
+def bench_collector_scrape() -> float:
+    """Fleet collector federation cost (devspace_tpu/obs/collector.py):
+    median milliseconds for one ``scrape_once`` round over 16 fake
+    targets plus the fleet exposition render — parse 16 expositions,
+    merge counters/gauges per aggregation hints and histograms
+    bucket-exactly, evaluate the fleet SLOs. Pure host-side Python
+    (fetch is injected), so it runs unconditionally; the regression
+    guard for ``collector_scrape_ms``."""
+    import statistics
+
+    from devspace_tpu.obs.collector import TelemetryCollector
+    from devspace_tpu.obs.metrics import Registry
+
+    texts = {}
+    for i in range(16):
+        reg = Registry()
+        reg.counter("engine_requests_completed_total", "done").inc(100 + i)
+        reg.counter("engine_requests_failed_total", "failed").inc(i)
+        reg.gauge("engine_tokens_per_sec_10s", "rate").set(40.0 + i)
+        reg.gauge("engine_active_slots", "active").set(2)
+        reg.gauge("engine_max_slots", "slots").set(4)
+        reg.gauge("engine_queued_requests", "queued").set(1)
+        ttft = reg.histogram("ttft_seconds", "ttft")
+        e2e = reg.histogram("request_e2e_seconds", "e2e")
+        for k in range(200):
+            ttft.observe(0.001 * (k % 40) + 0.0005)
+            e2e.observe(0.01 * (k % 25) + 0.001)
+        texts[f"http://bench-target-{i}:8000"] = reg.render().encode()
+
+    def fetch(url, _timeout):
+        base, sep, _rest = url.partition("/metrics")
+        if sep:
+            return texts[base]
+        if "/debug/events" in url:
+            return b'{"events": []}'
+        if "/debug/spans" in url:
+            return b'{"spans": []}'
+        if "/healthz" in url:
+            return b'{"ok": true}'
+        raise OSError(f"unexpected bench fetch: {url}")
+
+    collector = TelemetryCollector(sorted(texts), fetch=fetch)
+    collector.scrape_once()  # warm imports/allocations
+    samples = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        collector.scrape_once()
+        collector.render_metrics()
+        samples.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(samples)
+
+
 def main() -> int:
     if os.environ.get("DEVSPACE_BENCH_WEDGE_CHILD") and (
         "--resnet-child" in sys.argv
@@ -1121,6 +1173,19 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         notes.append(f"prefix-cache bench failed: {e}")
         log(f"[bench] prefix-cache bench failed: {e}")
+    # fleet collector federation microbenchmark (ISSUE 10): one scrape
+    # round over 16 fake targets + the merged exposition render — pure
+    # host-side Python, runs unconditionally like the prefix-cache leg
+    collector_scrape_ms = None
+    try:
+        collector_scrape_ms = round(bench_collector_scrape(), 2)
+        log(
+            f"[bench] collector scrape+merge round (16 targets): "
+            f"{collector_scrape_ms}ms"
+        )
+    except Exception as e:  # noqa: BLE001
+        notes.append(f"collector scrape bench failed: {e}")
+        log(f"[bench] collector scrape bench failed: {e}")
     sync_latency = None
     try:
         sync_latency = bench_sync_latency()
@@ -1300,6 +1365,8 @@ def main() -> int:
         # host-side radix prefix-cache costs (10k entries, 4k prompts)
         "prefix_match_us": prefix_match_us,
         "prefix_evict_us": prefix_evict_us,
+        # fleet collector scrape+merge round over 16 fake targets
+        "collector_scrape_ms": collector_scrape_ms,
     }
     hb(f"bench done (status={status})")
     print(json.dumps(result))

@@ -667,13 +667,103 @@ def _human_bytes(n) -> str:
     return f"{n:.1f}GiB"
 
 
+def _fleet_frame_lines(fleet: dict, events, args, url: str, tick: int) -> list:
+    """One ``top --fleet`` frame: fleet summary, per-target matrix,
+    fleet SLO table and merged recent events (rows carry their origin
+    target)."""
+    import time as _time
+
+    lines = []
+    stamp = _time.strftime("%H:%M:%S")
+    lines.append(f"devspace-tpu top — fleet @ {url}   {stamp}   frame {tick}")
+    lines.append("")
+    f = fleet.get("fleet") or {}
+
+    def num(v, fmt="{:.0f}"):
+        return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+    lines.append(
+        f"  FLEET  {f.get('up', 0)}/{f.get('targets', 0)} up"
+        f"  ({f.get('quarantined', 0)} quarantined)"
+        f"    tok/s {num(f.get('tok_s'), '{:.1f}')}"
+        f"   slots {num(f.get('active_slots'))}/{num(f.get('max_slots'))}"
+        f"   queued {num(f.get('queued'))}"
+    )
+    lines.append("")
+    rows = [["TARGET", "UP", "STALE", "TOK/S", "SLOTS", "QUEUED", "OCC",
+             "SLO"]]
+    for t in fleet.get("targets") or []:
+        slots = (
+            f"{num(t.get('active_slots'))}/{num(t.get('max_slots'))}"
+            if t.get("max_slots") is not None else "-"
+        )
+        stale = t.get("staleness_s")
+        rows.append([
+            str(t.get("target", "?")),
+            ("QUAR" if t.get("quarantined")
+             else "up" if t.get("up") else "DOWN"),
+            f"{stale:.1f}s" if isinstance(stale, (int, float)) else "-",
+            num(t.get("tok_s"), "{:.1f}"),
+            slots,
+            num(t.get("queued")),
+            num(t.get("occupancy"), "{:.2f}"),
+            str(t.get("slo") or "-"),
+        ])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        lines.append(
+            "  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+    lines.append("")
+
+    slo = fleet.get("slo") or {}
+    if slo.get("slos"):
+        lines.append("  FLEET SLO         STATUS  BURN(S)  BURN(L)")
+        for s in slo["slos"]:
+            lines.append(
+                f"  {s.get('name', '?'):<17} "
+                f"{s.get('status', '?'):<7} "
+                f"{s.get('burn_short', 0):>7.2f} "
+                f"{s.get('burn_long', 0):>8.2f}"
+            )
+        if not slo.get("ready", True):
+            lines.append("  !! FLEET NOT READY")
+        lines.append("")
+    for note in fleet.get("notes") or []:
+        lines.append(f"  note: {note}")
+
+    if events is not None and events.get("events"):
+        lines.append("  RECENT EVENTS")
+        for e in events["events"][-args.events:]:
+            ts = _time.strftime(
+                "%H:%M:%S", _time.localtime(e.get("time", 0))
+            )
+            attrs = " ".join(
+                f"{k}={v2}"
+                for k, v2 in e.items()
+                if k not in (
+                    "time", "seq", "level", "subsystem", "event",
+                    "span_id", "target",
+                )
+            )
+            lines.append(
+                f"  {ts}  [{e.get('target', '?')}] "
+                f"{e.get('level', '?'):<5} "
+                f"{e.get('subsystem', '?')}.{e.get('event', '?')}  {attrs}"
+            )
+    return lines
+
+
 def cmd_top(args) -> int:
     """``top``: live serving dashboard (ISSUE 9). Polls ``/metrics``
     (windowed tok/s, dispatch occupancy, KV-tier bytes, queue depth, SLO
     gauges) and ``/debug/events`` (recent structured events) from a
     running inference server, redrawing every ``--interval`` seconds.
-    ``--iterations N`` renders N frames and exits (scripting/tests);
-    the default 0 runs until Ctrl-C."""
+    With ``--fleet`` the URL names a ``collector serve`` endpoint and
+    each frame renders the per-target health/occupancy matrix, the
+    fleet SLO table over the *merged* distribution, and merged events
+    (ISSUE 10). ``--iterations N`` renders N frames and exits
+    (scripting/tests); the default 0 runs until Ctrl-C."""
     import json as _json
     import time as _time
     import urllib.error
@@ -693,6 +783,28 @@ def cmd_top(args) -> int:
     try:
         while True:
             tick += 1
+            if getattr(args, "fleet", False):
+                try:
+                    fleet = fetch("/debug/fleet", True)
+                except (urllib.error.URLError, OSError, ValueError) as e:
+                    log.error("no collector endpoint at %s: %s", url, e)
+                    return 1
+                try:
+                    events = fetch(
+                        f"/debug/events?limit={args.events}", True
+                    )
+                except (urllib.error.URLError, OSError, ValueError):
+                    events = None
+                lines = _fleet_frame_lines(fleet, events, args, url, tick)
+                import sys as _sys
+
+                if _sys.stdout.isatty() and args.iterations != 1:
+                    _sys.stdout.write("\x1b[2J\x1b[H")
+                print("\n".join(lines))
+                if args.iterations and tick >= args.iterations:
+                    return 0
+                _time.sleep(args.interval)
+                continue
             try:
                 fams = _parse_prom_text(fetch("/metrics", False))
                 health = fetch("/healthz", True)
@@ -772,7 +884,8 @@ def cmd_top(args) -> int:
                         f"{k}={v2}"
                         for k, v2 in e.items()
                         if k not in (
-                            "time", "level", "subsystem", "event", "span_id"
+                            "time", "seq", "level", "subsystem", "event",
+                            "span_id",
                         )
                     )
                     lines.append(
@@ -816,6 +929,8 @@ def cmd_debug(args) -> int:
     if not 0 <= args.seconds <= 60:
         log.error("--seconds must be in [0, 60], got %s", args.seconds)
         return 1
+    if getattr(args, "fleet", False) or getattr(args, "target", None):
+        return _debug_bundle_fleet(args, log)
 
     def fetch(path, timeout):
         with urllib.request.urlopen(url + path, timeout=timeout) as resp:
@@ -870,6 +985,161 @@ def cmd_debug(args) -> int:
     )
     for name, err in sorted(errors.items()):
         log.warn("  missing %s: %s", name, err)
+    return 0
+
+
+def _debug_bundle_fleet(args, log) -> int:
+    """``debug bundle --fleet``: one tar over every target (ISSUE 10).
+
+    Targets come from repeatable ``--target URL`` flags, or — with bare
+    ``--fleet`` — from the collector at ``--url`` (its ``/debug/fleet``
+    matrix names every replica). Each target's evidence lands under
+    ``bundle/<target>/``; per-target fetch failures are recorded in the
+    manifest exactly like the single-server bundle's per-member errors —
+    partial evidence beats none mid-incident."""
+    import io as _io
+    import json as _json
+    import re as _re
+    import tarfile
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/")
+
+    def fetch(base, path, timeout=10):
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.read()
+
+    fleet_doc = None
+    targets: list[tuple[str, str]] = []
+    if getattr(args, "target", None):
+        targets = [(t.rstrip("/"), t.rstrip("/")) for t in args.target]
+    else:
+        try:
+            fleet_doc = _json.loads(fetch(url, "/debug/fleet"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.error("no collector endpoint at %s: %s", url, e)
+            return 1
+        for row in fleet_doc.get("targets") or []:
+            if row.get("url"):
+                targets.append((row.get("target") or row["url"], row["url"]))
+    if not targets:
+        log.error("no fleet targets (pass --target URL or point --url at "
+                  "a collector)")
+        return 1
+
+    plan = [
+        ("metrics.txt", "/metrics"),
+        ("healthz.json", "/healthz"),
+        ("config.json", "/debug/config"),
+        ("requests.json", "/debug/requests?limit=500"),
+        ("events.json", "/debug/events?limit=2000"),
+        ("spans.json", "/debug/spans?limit=1024"),
+    ]
+    manifest_targets: dict = {}
+    members: dict = {}  # tar path -> bytes
+    if fleet_doc is not None:
+        members["fleet.json"] = _json.dumps(fleet_doc, indent=2).encode()
+        try:
+            members["fleet_metrics.txt"] = fetch(url, "/metrics")
+            members["fleet_trace.json"] = fetch(url, "/debug/trace")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.warn("collector evidence incomplete: %s", e)
+    fetched_any = bool(members)
+    for name, base in targets:
+        safe = _re.sub(r"[^A-Za-z0-9._-]+", "_", name).strip("_") or "target"
+        entry: dict = {"url": base, "members": [], "errors": {}}
+        for member, path in plan:
+            log.info("fetching %s%s ...", base, path)
+            try:
+                members[f"{safe}/{member}"] = fetch(base, path)
+                entry["members"].append(member)
+                fetched_any = True
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                entry["errors"][member] = str(e)
+        manifest_targets[safe] = entry
+    if not fetched_any:
+        log.error("no target answered; nothing to bundle")
+        return 1
+    manifest = {
+        "fleet": True,
+        "url": url,
+        "created": _time.time(),
+        "targets": manifest_targets,
+        "members": sorted(members),
+    }
+    with tarfile.open(args.out, "w:gz") as tar:
+        def add(name, data):
+            info = tarfile.TarInfo("bundle/" + name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, _io.BytesIO(data))
+
+        add("manifest.json", _json.dumps(manifest, indent=2).encode())
+        for name in sorted(members):
+            add(name, members[name])
+    failed = sum(len(t["errors"]) for t in manifest_targets.values())
+    log.done(
+        "wrote %s (%d member(s) from %d target(s)%s)", args.out,
+        len(members) + 1, len(targets),
+        f", {failed} fetch(es) failed" if failed else "",
+    )
+    for safe, entry in sorted(manifest_targets.items()):
+        for member, err in sorted(entry["errors"].items()):
+            log.warn("  missing %s/%s: %s", safe, member, err)
+    return 0
+
+
+def cmd_collector(args) -> int:
+    """``collector serve``: run the fleet telemetry collector (ISSUE
+    10) — scrape every target's ``/metrics``/``/healthz``/``/debug/*``
+    on an interval, federate them (counters summed, gauges per their
+    aggregation hints, latency histograms merged bucket-exactly) and
+    serve the fleet view: ``/metrics``, ``/debug/fleet``,
+    ``/debug/events`` (merged), ``/debug/trace`` (stitched). Targets
+    are repeatable ``--target URL`` flags or ``--workers`` (resolve the
+    slice's worker pods through the selector layer)."""
+    from ..obs.collector import TelemetryCollector, make_http_server
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    if args.target:
+        collector = TelemetryCollector.from_replicas(
+            args.target, interval_s=args.interval,
+        )
+    elif args.workers:
+        ctx = Context(args)
+        collector = TelemetryCollector.from_workers(
+            ctx.backend, ctx.config, port=args.scrape_port,
+            selector_name=getattr(args, "selector", None),
+            interval_s=args.interval,
+        )
+    else:
+        log.error("no targets: pass --target URL (repeatable) or --workers")
+        return 1
+    collector.scrape_once()  # first federated view before we listen
+    httpd = make_http_server(collector, args.host, args.port)
+    collector.start()
+    up = sum(1 for t in collector.targets if t.up)
+    log.done(
+        "collector serving on http://%s:%d (%d target(s), %d up; "
+        "scrape interval %.1fs)",
+        args.host, httpd.server_address[1], len(collector.targets), up,
+        args.interval,
+    )
+    try:
+        if getattr(args, "iterations", 0):
+            # test/scripting mode: handle N requests then exit
+            for _ in range(args.iterations):
+                httpd.handle_request()
+            return 0
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        collector.stop()
+        httpd.server_close()
     return 0
 
 
@@ -1969,6 +2239,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=8,
         help="recent structured events to show per frame",
     )
+    sp.add_argument(
+        "--fleet",
+        action="store_true",
+        help="the URL names a `collector serve` endpoint: render the "
+        "per-target matrix, fleet SLO table and merged events",
+    )
     sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser(
@@ -1996,7 +2272,65 @@ def build_parser() -> argparse.ArgumentParser:
         default=2.0,
         help="timeline capture window in seconds (0 skips the capture)",
     )
+    q.add_argument(
+        "--fleet",
+        action="store_true",
+        help="bundle every target of the collector at --url (per-target "
+        "subdirectories + per-target error records in the manifest)",
+    )
+    q.add_argument(
+        "--target",
+        action="append",
+        default=None,
+        metavar="URL",
+        help="explicit fleet target (repeatable; implies --fleet)",
+    )
     q.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser(
+        "collector",
+        help="fleet telemetry: scrape N servers, serve the federated view",
+    )
+    coll_sub = sp.add_subparsers(dest="what", required=True)
+    q = coll_sub.add_parser(
+        "serve",
+        help="scrape every target on an interval and serve the merged "
+        "/metrics, /debug/fleet, /debug/events and stitched /debug/trace",
+    )
+    q.add_argument(
+        "--target",
+        action="append",
+        default=None,
+        metavar="URL",
+        help="scrape target base URL (repeatable)",
+    )
+    q.add_argument(
+        "--workers",
+        action="store_true",
+        help="discover targets by resolving the slice's worker pods "
+        "through the selector layer",
+    )
+    q.add_argument(
+        "--scrape-port",
+        type=int,
+        default=8000,
+        help="serving port on discovered workers (with --workers)",
+    )
+    q.add_argument("--host", default="127.0.0.1", help="bind address")
+    q.add_argument("--port", type=int, default=9090, help="listen port")
+    q.add_argument(
+        "--interval",
+        type=float,
+        default=5.0,
+        help="seconds between scrape rounds",
+    )
+    q.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="serve N HTTP requests then exit (0 = run until Ctrl-C)",
+    )
+    q.set_defaults(fn=cmd_collector)
 
     sp = sub.add_parser("add", help="add config entries")
     add_sub = sp.add_subparsers(dest="kind", required=True)

@@ -93,86 +93,86 @@ _RESTORE_BATCH = 16
 # Format: (name, kind, help, stats_key).
 ENGINE_METRIC_FAMILIES = (
     ("engine_requests_completed_total", "counter",
-     "Requests that finished successfully", "requests_completed"),
+     "Requests that finished successfully", "requests_completed", "sum"),
     ("engine_requests_failed_total", "counter",
      "Requests that failed (dispatch faults, bad admissions, stop())",
-     "requests_failed"),
+     "requests_failed", "sum"),
     ("engine_requests_preempted_total", "counter",
      "Preemption events (a request may be preempted more than once)",
-     "requests_preempted"),
+     "requests_preempted", "sum"),
     ("engine_tokens_generated_total", "counter",
-     "Generated tokens emitted across all requests", "tokens_generated"),
+     "Generated tokens emitted across all requests", "tokens_generated", "sum"),
     ("engine_prefix_hit_blocks_total", "counter",
      "Prompt blocks served from the radix prefix cache at admission",
-     "prefix_hit_blocks"),
+     "prefix_hit_blocks", "sum"),
     ("engine_prefix_hit_tokens_total", "counter",
      "Prompt tokens whose prefill was skipped at admission (resident "
-     "radix hits plus host-tier restores)", "prefix_hit_tokens"),
+     "radix hits plus host-tier restores)", "prefix_hit_tokens", "sum"),
     ("engine_recompute_tokens_saved_total", "counter",
      "Prompt tokens restored from the host KV tier instead of "
      "recompute-prefilled (the tier-attributable subset of prefix hits)",
-     "recompute_tokens_saved"),
+     "recompute_tokens_saved", "sum"),
     ("engine_kv_spill_bytes_total", "counter",
      "Bytes of evicted KV copied device->host into the tier (packed, "
-     "int8-quantized)", "kv_spill_bytes"),
+     "int8-quantized)", "kv_spill_bytes", "sum"),
     ("engine_kv_spill_blocks_total", "counter",
-     "Evicted KV blocks spilled to the host tier", "kv_spill_blocks"),
+     "Evicted KV blocks spilled to the host tier", "kv_spill_blocks", "sum"),
     ("engine_kv_restore_hits_total", "counter",
      "Spilled blocks restored host->device on a radix match",
-     "kv_restore_hits"),
+     "kv_restore_hits", "sum"),
     ("engine_kv_restore_fallbacks_total", "counter",
      "Restore attempts that fell back to recompute-prefill (tier miss, "
-     "corrupt payload, or restore error)", "kv_restore_fallbacks"),
+     "corrupt payload, or restore error)", "kv_restore_fallbacks", "sum"),
     ("engine_kv_tier_resident_bytes", "gauge",
-     "Host RAM currently held by the KV tier", "kv_tier_resident_bytes"),
+     "Host RAM currently held by the KV tier", "kv_tier_resident_bytes", "sum"),
     # histogram families carry no stats_key: _register_metric_families
     # creates a real instrument (observed per restore event) instead of
     # a pull callback
     ("engine_kv_restore_seconds", "histogram",
      "Latency of one spilled-chain restore (tier reads + scatter "
-     "dispatches; async device work excluded)", None),
+     "dispatches; async device work excluded)", None, "sum"),
     ("engine_decode_dispatches_total", "counter",
      "Decode chunks dispatched by the overlapped serving loop",
-     "decode_dispatches"),
+     "decode_dispatches", "sum"),
     ("engine_readback_wait_seconds_total", "counter",
-     "Host time blocked on decode token readback", "readback_wait_s"),
+     "Host time blocked on decode token readback", "readback_wait_s", "sum"),
     ("engine_spec_rounds_total", "counter",
      "Speculative draft/verify rounds replayed by the host commit loop",
-     "spec_rounds"),
+     "spec_rounds", "sum"),
     ("engine_spec_proposed_total", "counter",
      "Draft tokens proposed in replayed speculative rounds",
-     "spec_proposed"),
+     "spec_proposed", "sum"),
     ("engine_spec_accepted_total", "counter",
-     "Draft tokens accepted by target verification", "spec_accepted"),
+     "Draft tokens accepted by target verification", "spec_accepted", "sum"),
     ("engine_spec_committed_total", "counter",
-     "Tokens committed from speculative rounds", "spec_committed"),
+     "Tokens committed from speculative rounds", "spec_committed", "sum"),
     ("engine_active_slots", "gauge",
-     "Slots currently decoding (prefill complete)", "active_slots"),
+     "Slots currently decoding (prefill complete)", "active_slots", "sum"),
     ("engine_prefilling_slots", "gauge",
-     "Slots currently in chunked prefill", "prefilling_slots"),
+     "Slots currently in chunked prefill", "prefilling_slots", "sum"),
     ("engine_max_slots", "gauge",
-     "Configured concurrent-sequence capacity", "max_slots"),
+     "Configured concurrent-sequence capacity", "max_slots", "sum"),
     ("engine_queued_requests", "gauge",
      "Requests waiting for a slot (pending queue + preempted resume list)",
-     "queued"),
+     "queued", "sum"),
     ("engine_free_kv_blocks", "gauge",
-     "Unallocated KV pool blocks", "free_blocks"),
+     "Unallocated KV pool blocks", "free_blocks", "sum"),
     ("engine_kv_blocks", "gauge",
      "Allocatable KV pool blocks (excludes the scratch block)",
-     "total_blocks"),
+     "total_blocks", "sum"),
     ("engine_prefix_cached_blocks", "gauge",
      "Blocks currently published in the radix prefix cache",
-     "prefix_cached_blocks"),
+     "prefix_cached_blocks", "sum"),
     ("engine_dispatch_depth", "gauge",
-     "Configured dispatch-ahead window depth", "dispatch_depth"),
+     "Configured dispatch-ahead window depth", "dispatch_depth", "max"),
     ("engine_dispatch_depth_occupancy", "gauge",
      "Mean in-flight window depth observed at dispatch",
-     "dispatch_depth_occupancy"),
+     "dispatch_depth_occupancy", "avg"),
     ("engine_uptime_seconds", "gauge",
-     "Seconds since the scheduler thread started", "uptime_s"),
+     "Seconds since the scheduler thread started", "uptime_s", "max"),
     ("engine_tokens_per_sec_10s", "gauge",
      "Generated tokens per second over the last ~10s window",
-     "tokens_per_sec_10s"),
+     "tokens_per_sec_10s", "sum"),
 )
 
 
@@ -1383,7 +1383,7 @@ class InferenceEngine:
 
             return fn
 
-        for name, kind, help_, key in ENGINE_METRIC_FAMILIES:
+        for name, kind, help_, key, _agg in ENGINE_METRIC_FAMILIES:
             if kind == "histogram":
                 # histograms are real instruments observed per event,
                 # not pull callbacks over stats() ints

@@ -6,6 +6,11 @@
 - :mod:`devspace_tpu.obs.request_trace` — per-request serving lifecycle
   recorder producing TTFT / TPOT / queue-wait / prefill / e2e
   histograms and a bounded ring of recent request traces.
+- :mod:`devspace_tpu.obs.fleet` — exposition parse/merge: counters
+  summed, gauges per aggregation hints, histograms merged
+  bucket-exactly; cross-process Chrome-trace stitching.
+- :mod:`devspace_tpu.obs.collector` — the pull-based fleet collector
+  behind ``devspace-tpu collector serve`` (ISSUE 10).
 
 Every serving subsystem registers its counters here as metric families;
 the existing ``stats()`` dicts stay byte-compatible (they and the
@@ -60,7 +65,34 @@ from .tracing import (
     get_tracer,
 )
 
+# fleet federation last: collector pulls in every catalog above (and
+# resilience.policy, which imports back into this package)
+from .collector import (  # noqa: E402
+    COLLECTOR_METRIC_FAMILIES,
+    TelemetryCollector,
+    make_http_server,
+)
+from .fleet import (  # noqa: E402
+    FLEET_AGG_KINDS,
+    ExpositionParseError,
+    aggregation_hints,
+    family_agg,
+    merge_snapshots,
+    parse_exposition,
+    stitch_chrome_trace,
+)
+
 __all__ = [
+    "COLLECTOR_METRIC_FAMILIES",
+    "TelemetryCollector",
+    "make_http_server",
+    "FLEET_AGG_KINDS",
+    "ExpositionParseError",
+    "aggregation_hints",
+    "family_agg",
+    "merge_snapshots",
+    "parse_exposition",
+    "stitch_chrome_trace",
     "EVENT_CATALOG",
     "EVENT_SUBSYSTEMS",
     "EVENTS_METRIC_FAMILIES",

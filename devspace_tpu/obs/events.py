@@ -34,6 +34,7 @@ Design constraints, in order:
 from __future__ import annotations
 
 import io
+import itertools
 import json
 import os
 import threading
@@ -95,9 +96,9 @@ EVENT_CATALOG = (
 
 EVENTS_METRIC_FAMILIES = (
     ("events_emitted_total", "counter",
-     "Structured events fanned out to at least one sink"),
+     "Structured events fanned out to at least one sink", "sum"),
     ("events_dropped_total", "counter",
-     "Structured events a sink raised on (sink bug, full disk, ...)"),
+     "Structured events a sink raised on (sink bug, full disk, ...)", "sum"),
 )
 
 # Keys owned by the envelope; attrs may not shadow them. "msg" stays an
@@ -106,14 +107,21 @@ EVENTS_METRIC_FAMILIES = (
 _RESERVED_KEYS = ("time", "level", "subsystem", "event", "trace_id", "span_id")
 
 
+# Process-wide emission order. time.time() has finite resolution, so
+# two events in one scheduler iteration often share a timestamp; the
+# seq is the tie-break that keeps dump ordering stable (ISSUE 10).
+_event_seq = itertools.count(1)
+
+
 class Event:
     """One structured event. Immutable by convention; ``attrs`` is the
     free-form payload (small, JSON-serializable values only)."""
 
-    __slots__ = ("ts", "level", "subsystem", "name", "attrs", "trace_id", "span_id")
+    __slots__ = ("ts", "level", "subsystem", "name", "attrs", "trace_id",
+                 "span_id", "seq")
 
     def __init__(self, ts, level, subsystem, name, attrs=None,
-                 trace_id=None, span_id=None):
+                 trace_id=None, span_id=None, seq=None):
         self.ts = float(ts)
         self.level = level
         self.subsystem = subsystem
@@ -121,10 +129,12 @@ class Event:
         self.attrs = attrs or {}
         self.trace_id = trace_id
         self.span_id = span_id
+        self.seq = int(seq) if seq is not None else next(_event_seq)
 
     def to_dict(self) -> dict:
         d = {
             "time": self.ts,
+            "seq": self.seq,
             "level": self.level,
             "subsystem": self.subsystem,
             "event": self.name,
@@ -188,7 +198,11 @@ class FlightRecorder:
                 events = list(self._rings.get(subsystem, ()))
             else:
                 events = [e for ring in self._rings.values() for e in ring]
-        events.sort(key=lambda e: e.ts)
+        # (ts, seq): equal timestamps are common (time.time() resolution
+        # vs a tight scheduler loop) and a bare ts sort is only stable
+        # WITHIN one ring — merging rings interleaved same-ts events in
+        # ring-dict order. seq pins emission order across rings.
+        events.sort(key=lambda e: (e.ts, e.seq))
         if limit is not None and limit >= 0:
             events = events[-limit:]
         return events
@@ -372,8 +386,8 @@ def lint_catalog() -> list[str]:
 
 def _register_metrics() -> None:
     reg = get_registry()
-    emitted_name, _, emitted_help = EVENTS_METRIC_FAMILIES[0]
-    dropped_name, _, dropped_help = EVENTS_METRIC_FAMILIES[1]
+    emitted_name, _, emitted_help, _agg = EVENTS_METRIC_FAMILIES[0]
+    dropped_name, _, dropped_help, _agg = EVENTS_METRIC_FAMILIES[1]
     reg.register_callback(
         emitted_name, "counter", emitted_help, lambda: _default_bus.emitted
     )

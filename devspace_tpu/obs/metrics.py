@@ -347,25 +347,7 @@ class Registry:
 
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""
-        lines: list[str] = []
-        for name, fam in sorted(self.snapshot().items()):
-            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
-            lines.append(f"# TYPE {name} {fam['kind']}")
-            for labels, val in fam["samples"]:
-                if fam["kind"] == "histogram":
-                    for le, cum in val["buckets"]:
-                        lb = dict(labels)
-                        lb["le"] = _fmt(le)
-                        lines.append(f"{name}_bucket{_label_str(lb)} {cum}")
-                    lines.append(
-                        f"{name}_sum{_label_str(labels)} {_fmt(val['sum'])}"
-                    )
-                    lines.append(
-                        f"{name}_count{_label_str(labels)} {val['count']}"
-                    )
-                else:
-                    lines.append(f"{name}{_label_str(labels)} {_fmt(val)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        return render_snapshot(self.snapshot())
 
     def render_openmetrics(self) -> str:
         """OpenMetrics 1.0 text exposition — same families as
@@ -411,6 +393,31 @@ class Registry:
                     lines.append(f"{name}{_label_str(labels)} {_fmt(val)}")
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+
+def render_snapshot(snap: dict) -> str:
+    """Prometheus text exposition 0.0.4 from a :meth:`Registry.snapshot`
+    -shaped dict. Module-level so the fleet collector can render a
+    *merged* snapshot that never lived in a Registry (obs/fleet.py)."""
+    lines: list[str] = []
+    for name, fam in sorted(snap.items()):
+        lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        for labels, val in fam["samples"]:
+            if fam["kind"] == "histogram":
+                for le, cum in val["buckets"]:
+                    lb = dict(labels)
+                    lb["le"] = _fmt(le)
+                    lines.append(f"{name}_bucket{_label_str(lb)} {cum}")
+                lines.append(
+                    f"{name}_sum{_label_str(labels)} {_fmt(val['sum'])}"
+                )
+                lines.append(
+                    f"{name}_count{_label_str(labels)} {val['count']}"
+                )
+            else:
+                lines.append(f"{name}{_label_str(labels)} {_fmt(val)}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class WindowedRate:

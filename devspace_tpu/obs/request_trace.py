@@ -51,31 +51,37 @@ SERVING_METRIC_FAMILIES = (
         "ttft_seconds",
         "histogram",
         "Time from request enqueue to its first generated token",
+        "sum",
     ),
     (
         "tpot_seconds",
         "histogram",
         "Mean time per output token after the first, per completed request",
+        "sum",
     ),
     (
         "queue_wait_seconds",
         "histogram",
         "Time from request enqueue to its first slot admission",
+        "sum",
     ),
     (
         "prefill_seconds",
         "histogram",
         "Time from first admission to prefill completion (chunked prefill)",
+        "sum",
     ),
     (
         "request_e2e_seconds",
         "histogram",
         "Time from request enqueue to completion",
+        "sum",
     ),
     (
         "requests_finished_total",
         "counter",
         "Terminal request outcomes by kind (completed/failed)",
+        "sum",
     ),
 )
 
@@ -246,7 +252,7 @@ class ServingTelemetry:
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
         self._ring: deque[RequestTrace] = deque(maxlen=ring)
-        by_name = {name: (kind, help_) for name, kind, help_ in SERVING_METRIC_FAMILIES}
+        by_name = {name: (kind, help_) for name, kind, help_, _agg in SERVING_METRIC_FAMILIES}
 
         def hist(name):
             return self.registry.histogram(name, by_name[name][1])
@@ -367,6 +373,23 @@ class ServingTelemetry:
             for row in rows:
                 fh.write(json.dumps(row) + "\n")
         return len(rows)
+
+    def recent_spans(
+        self, limit: int = 512, trace_id: Optional[str] = None
+    ) -> list[dict]:
+        """Lifecycle-phase span dicts for the newest requests (newest
+        last) — the per-process feed the fleet collector stitches into
+        one cross-worker Chrome trace. Starts are wall-clock and the
+        dicts carry the distributed ``trace_id``, so lanes from N
+        replicas line up on one timeline."""
+        with self._lock:
+            traces = list(self._ring)
+        spans = [s for t in traces for s in t.to_spans()]
+        if trace_id is not None:
+            spans = [s for s in spans if s.get("trace_id") == trace_id]
+        for s in spans:
+            s.setdefault("track", s.get("thread") or "serving")
+        return spans[-max(0, limit):]
 
     def export_chrome(self, dest: str) -> int:
         """Chrome-trace (chrome://tracing / Perfetto) export of the

@@ -36,10 +36,10 @@ from .metrics import Registry, _validate_name
 
 SLO_METRIC_FAMILIES = (
     ("slo_status", "gauge",
-     "SLO state per objective: 0 ok, 1 warn, 2 breach"),
+     "SLO state per objective: 0 ok, 1 warn, 2 breach", "max"),
     ("slo_burn_ratio", "gauge",
      "Error-budget burn rate per SLO and window "
-     "(1.0 = burning exactly the budget)"),
+     "(1.0 = burning exactly the budget)", "max"),
 )
 
 _STATUS_ORDER = {"ok": 0, "warn": 1, "breach": 2}
@@ -384,8 +384,8 @@ class SLOEvaluator:
     def register_metrics(self, registry: Registry) -> None:
         """Expose per-SLO status + burn gauges on ``registry`` via
         pull callbacks (no bookkeeping beyond the last evaluation)."""
-        status_name, _, status_help = SLO_METRIC_FAMILIES[0]
-        burn_name, _, burn_help = SLO_METRIC_FAMILIES[1]
+        status_name, _, status_help, _agg = SLO_METRIC_FAMILIES[0]
+        burn_name, _, burn_help, _agg = SLO_METRIC_FAMILIES[1]
 
         def _status_samples():
             return [

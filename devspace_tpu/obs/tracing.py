@@ -47,11 +47,13 @@ TRACING_METRIC_FAMILIES = (
         "trace_spans_started_total",
         "counter",
         "Spans opened on the process-wide tracer",
+        "sum",
     ),
     (
         "trace_timeline_exports_total",
         "counter",
         "Engine timeline captures rendered to Chrome-trace JSON",
+        "sum",
     ),
 )
 
@@ -490,8 +492,8 @@ def _register_metrics() -> None:
         from .metrics import get_registry
 
         reg = get_registry()
-        spans_name, _, spans_help = TRACING_METRIC_FAMILIES[0]
-        exports_name, _, exports_help = TRACING_METRIC_FAMILIES[1]
+        spans_name, _, spans_help, _agg = TRACING_METRIC_FAMILIES[0]
+        exports_name, _, exports_help, _agg = TRACING_METRIC_FAMILIES[1]
         reg.register_callback(
             spans_name, "counter", spans_help,
             lambda: _default_tracer.started,

@@ -34,21 +34,24 @@ RESILIENCE_METRIC_FAMILIES = (
         "resilience_retry_attempts_total",
         "counter",
         "Backoff waits taken before retrying a failed operation",
+        "sum",
     ),
     (
         "resilience_retries_exhausted_total",
         "counter",
         "Operations abandoned after exhausting retry attempts or deadline",
+        "sum",
     ),
     (
         "resilience_circuit_open_total",
         "counter",
         "Circuit-breaker transitions into the open state",
+        "sum",
     ),
 )
 
 def _counter(idx: int):
-    name, _kind, help_ = RESILIENCE_METRIC_FAMILIES[idx]
+    name, _kind, help_, _agg = RESILIENCE_METRIC_FAMILIES[idx]
     return get_registry().counter(name, help_)
 
 

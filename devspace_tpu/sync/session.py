@@ -167,21 +167,22 @@ class SyncOptions:
     pipeline_depth: int = 3
 
 
-# (name, kind, help, stats_key) — lintable catalog (scripts/metrics_lint.py).
+# (name, kind, help, stats_key, agg) — lintable catalog
+# (scripts/metrics_lint.py); agg is the fleet aggregation hint.
 # Registered once as pull-style callbacks that aggregate over every live
 # session: the stats dict stays the single mutation site ("two views, one
 # truth") and `status sync` output is untouched.
 SYNC_METRIC_FAMILIES = (
-    ("sync_uploaded_total", "counter", "Files uploaded to workers", "uploaded"),
-    ("sync_downloaded_total", "counter", "Files mirrored back from workers", "downloaded"),
-    ("sync_removed_local_total", "counter", "Local files removed by downstream mirroring", "removed_local"),
-    ("sync_removed_remote_total", "counter", "Remote files removed by upstream mirroring", "removed_remote"),
-    ("sync_repaired_total", "counter", "Files re-pushed by the verify/repair loop", "repaired"),
-    ("sync_sent_bytes_total", "counter", "Payload bytes broadcast to workers", "bytes_sent"),
-    ("sync_meta_fixes_total", "counter", "Metadata-only fixes (mtime/mode) applied remotely", "meta_fixes"),
-    ("sync_saved_digest_bytes_total", "counter", "Upload bytes avoided by digest gating", "bytes_saved_digest"),
-    ("sync_pipeline_stall_seconds_total", "counter", "Producer time blocked on full per-worker send queues", "pipeline_stall_s"),
-    ("sync_workers_quarantined_total", "counter", "Workers dropped from the fan-out after unrecoverable errors", "workers_quarantined"),
+    ("sync_uploaded_total", "counter", "Files uploaded to workers", "uploaded", "sum"),
+    ("sync_downloaded_total", "counter", "Files mirrored back from workers", "downloaded", "sum"),
+    ("sync_removed_local_total", "counter", "Local files removed by downstream mirroring", "removed_local", "sum"),
+    ("sync_removed_remote_total", "counter", "Remote files removed by upstream mirroring", "removed_remote", "sum"),
+    ("sync_repaired_total", "counter", "Files re-pushed by the verify/repair loop", "repaired", "sum"),
+    ("sync_sent_bytes_total", "counter", "Payload bytes broadcast to workers", "bytes_sent", "sum"),
+    ("sync_meta_fixes_total", "counter", "Metadata-only fixes (mtime/mode) applied remotely", "meta_fixes", "sum"),
+    ("sync_saved_digest_bytes_total", "counter", "Upload bytes avoided by digest gating", "bytes_saved_digest", "sum"),
+    ("sync_pipeline_stall_seconds_total", "counter", "Producer time blocked on full per-worker send queues", "pipeline_stall_s", "sum"),
+    ("sync_workers_quarantined_total", "counter", "Workers dropped from the fan-out after unrecoverable errors", "workers_quarantined", "sum"),
 )
 
 # Live sessions for the aggregate metric callbacks — weak so the registry
@@ -194,7 +195,7 @@ def _register_sync_metrics() -> None:
         from ..obs.metrics import get_registry
 
         reg = get_registry()
-        for name, kind, help_, key in SYNC_METRIC_FAMILIES:
+        for name, kind, help_, key, _agg in SYNC_METRIC_FAMILIES:
 
             def fn(key=key):
                 total = 0.0

@@ -39,6 +39,7 @@ TRACE_METRIC_FAMILIES = (
         "trace_spans_dropped_total",
         "counter",
         "Spans evicted from the in-memory ring (oldest-first rotation)",
+        "sum",
     ),
 )
 
@@ -207,7 +208,7 @@ def _register_metrics() -> None:
     try:
         from ..obs.metrics import get_registry
 
-        name, kind, help_ = TRACE_METRIC_FAMILIES[0]
+        name, kind, help_, _agg = TRACE_METRIC_FAMILIES[0]
         get_registry().register_callback(name, kind, help_, dropped)
     except Exception:  # noqa: BLE001 — metrics are optional here
         pass

@@ -15,7 +15,10 @@ per-request serving traces; ?limit=N caps rows, ?outcome=completed|
 cancelled|failed|in-flight filters) and /debug/trace?seconds=N (records
 the engine timeline for N seconds and returns Chrome-trace JSON —
 docs/observability.md "Timeline profiler", or `devspace-tpu profile
-serving`). An inbound W3C `traceparent` header on /generate or
+serving`) and /debug/spans (?trace_id=/?limit=: this process's request
+lifecycle-phase spans + finished tracer spans, the per-replica feed
+`devspace-tpu collector serve` stitches into one cross-worker Chrome
+trace). An inbound W3C `traceparent` header on /generate or
 /generate_speculative joins the request's serving spans to the caller's
 distributed trace. Concurrent requests are
 continuously batched by devspace_tpu.inference.InferenceEngine
@@ -376,6 +379,42 @@ def main(argv=None):
                 )
             elif path == "/debug/config":
                 self._json(200, server.config())
+            elif path == "/debug/spans":
+                # this process's spans for the fleet collector's
+                # cross-process trace stitching: request lifecycle-phase
+                # spans from the engine telemetry ring (they carry the
+                # caller's distributed trace_id) plus the finished-span
+                # ring (obs/tracing.py). Wall-clock starts, so lanes
+                # from N replicas line up on one timeline.
+                try:
+                    limit = int(qs.get("limit", ["512"])[0])
+                except ValueError:
+                    self._json(400, {"error": "limit must be an integer"})
+                    return
+                from devspace_tpu.obs import get_tracer
+
+                trace_id = qs.get("trace_id", [None])[0]
+                tracer = get_tracer()
+                tracer_spans = (
+                    tracer.find(trace_id)
+                    if trace_id
+                    else tracer.recent(max(0, limit))
+                )
+                spans = [s.to_dict() for s in tracer_spans]
+                tel = server.engine.telemetry
+                if tel is not None:
+                    spans.extend(
+                        tel.recent_spans(
+                            limit=max(0, limit), trace_id=trace_id
+                        )
+                    )
+                self._json(
+                    200,
+                    {
+                        "process": f"serve:{os.getpid()}",
+                        "spans": spans[-max(0, limit):],
+                    },
+                )
             elif path == "/metrics":
                 # Prometheus text exposition: the engine's private
                 # registry (serving histograms + engine gauges) plus the

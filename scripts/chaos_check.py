@@ -54,6 +54,11 @@ REQUIRED_CHAOS_MODULES = (
     # injected fault must emit restart/degraded events on the session
     # trace
     "test_obs_events",
+    # fleet federation degradation ladder (ISSUE 10): a hard-down
+    # target must flip to up=0 with a climbing staleness gauge while
+    # the rest of the fleet still renders; garbage exposition must be
+    # counted and quarantined, never raise out of the collector
+    "test_obs_fleet",
 )
 
 

@@ -11,6 +11,9 @@ Prometheus tooling chokes on or operators can't grep:
 - histograms and time/size gauges carry a unit suffix (``_seconds``,
   ``_bytes``, or an explicit whitelist for unit-less gauges)
 - help strings are nonempty and don't repeat the metric name verbatim
+- every family declares a fleet aggregation hint as its LAST element
+  (``sum``/``max``/``avg``/``last`` — obs/fleet.py federation); counters
+  and histograms must declare ``sum`` (they merge exactly)
 - no duplicate names across catalogs (the /metrics endpoint concatenates
   the engine registry with the process-wide one — prefixes must stay
   disjoint)
@@ -44,12 +47,15 @@ _UNITLESS_GAUGE_SUFFIXES = (
     "_ratio",
 )
 _RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
+# collector fleet gauges: target counts and health bits
+_UNITLESS_GAUGE_SUFFIXES += ("_targets", "_targets_up", "_up", "_quarantined")
 
 
 def load_catalogs() -> dict[str, tuple]:
     """{catalog label: ((name, kind, help, *rest), ...)} — import order
     matters only for jax (engine); everything else is dependency-free."""
     from devspace_tpu.inference.engine import ENGINE_METRIC_FAMILIES
+    from devspace_tpu.obs.collector import COLLECTOR_METRIC_FAMILIES
     from devspace_tpu.obs.events import EVENTS_METRIC_FAMILIES
     from devspace_tpu.obs.request_trace import SERVING_METRIC_FAMILIES
     from devspace_tpu.obs.slo import SLO_METRIC_FAMILIES
@@ -67,6 +73,7 @@ def load_catalogs() -> dict[str, tuple]:
         "tracing": TRACING_METRIC_FAMILIES,
         "events": EVENTS_METRIC_FAMILIES,
         "slo": SLO_METRIC_FAMILIES,
+        "collector": COLLECTOR_METRIC_FAMILIES,
     }
 
 
@@ -103,6 +110,23 @@ def lint(catalogs: dict[str, tuple]) -> list[str]:
                 problems.append(f"{where}: empty help string")
             elif help_.strip() == name:
                 problems.append(f"{where}: help string just repeats the name")
+            # fleet aggregation hint (ISSUE 10): the federation layer
+            # (obs/fleet.py) refuses to guess how a family merges — the
+            # catalog must say. Counters and histograms merge exactly,
+            # so anything but "sum" on them is a contradiction.
+            from devspace_tpu.obs.fleet import FLEET_AGG_KINDS
+
+            hint = fam[-1]
+            if hint not in FLEET_AGG_KINDS:
+                problems.append(
+                    f"{where}: missing/invalid aggregation hint {hint!r} as "
+                    f"the last tuple element (want one of {FLEET_AGG_KINDS})"
+                )
+            elif kind in ("counter", "histogram") and hint != "sum":
+                problems.append(
+                    f"{where}: {kind}s merge exactly across the fleet — "
+                    f"the hint must be \"sum\", not {hint!r}"
+                )
             if name in seen:
                 problems.append(
                     f"{where}: duplicate of {seen[name]} (the /metrics "

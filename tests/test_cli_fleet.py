@@ -1,0 +1,352 @@
+"""Fleet CLI tests (``collector serve``, ``top --fleet``, ``debug
+bundle --fleet`` — ISSUE 10).
+
+Stub ``http.server`` replicas serve real registry expositions; the
+collector federates them over actual HTTP and the CLI surfaces are
+pinned end-to-end — no engine, no sleeps. The live 3-replica pass is
+the slow-marked test in test_fleet_live.py.
+"""
+
+import json
+import socket
+import tarfile
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from devspace_tpu.cli.main import main
+from devspace_tpu.obs.metrics import Registry
+from devspace_tpu.utils import log as logutil
+
+TRACE = "cd" * 16
+
+
+def _replica_metrics(tok_s, completed, ttft_obs):
+    r = Registry()
+    r.gauge("engine_tokens_per_sec_10s", "rate").set(tok_s)
+    r.gauge("engine_active_slots", "a").set(2)
+    r.gauge("engine_max_slots", "m").set(4)
+    r.gauge("engine_queued_requests", "q").set(1)
+    r.counter("engine_requests_completed_total", "done").inc(completed)
+    h = r.histogram("ttft_seconds", "ttft")
+    for v in ttft_obs:
+        h.observe(v)
+    return r.render()
+
+
+class ReplicaHandler(BaseHTTPRequestHandler):
+    metrics_text = _replica_metrics(40.0, 10, [0.01, 0.02])
+    omit = ()
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        payloads = {
+            "/metrics": ("text/plain", self.metrics_text.encode()),
+            "/healthz": ("application/json", json.dumps(
+                {"ok": True, "slo": {"status": "ok"}}).encode()),
+            "/debug/events": ("application/json", json.dumps({
+                "events": [{"time": 1754500000.0, "seq": 1, "level": "info",
+                            "subsystem": "engine", "event": "admit"}],
+            }).encode()),
+            "/debug/spans": ("application/json", json.dumps({
+                "process": "serve:1",
+                "spans": [{"name": "generate", "trace_id": TRACE,
+                           "span_id": "11" * 8, "start": 10.0,
+                           "duration_s": 0.5, "track": "http"}],
+            }).encode()),
+            "/debug/requests": ("application/json", b'{"requests": []}'),
+            "/debug/config": ("application/json", b'{"model": "tiny"}'),
+        }
+        if path in self.omit or path not in payloads:
+            self.send_error(404)
+            return
+        ctype, body = payloads[path]
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def _start(handler):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture
+def replica_urls():
+    pairs = [_start(ReplicaHandler) for _ in range(2)]
+    try:
+        yield [u for _s, u in pairs]
+    finally:
+        for s, _u in pairs:
+            s.shutdown()
+            s.server_close()
+
+
+class _DynStream:
+    def write(self, s):
+        import sys
+
+        return sys.stdout.write(s)
+
+    def flush(self):
+        import sys
+
+        sys.stdout.flush()
+
+    def isatty(self):
+        return False
+
+
+@pytest.fixture(autouse=True)
+def stdout_logger():
+    logutil.set_logger(logutil.StdoutLogger(stream=_DynStream()))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- collector serve ---------------------------------------------------------
+def test_collector_serve_federates_over_http(replica_urls):
+    port = _free_port()
+    paths = ["/metrics", "/healthz", "/debug/fleet",
+             "/debug/events?limit=10", f"/debug/trace?trace_id={TRACE}"]
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(main(
+            ["collector", "serve", "--port", str(port),
+             "--iterations", str(len(paths))]
+            + [f for u in replica_urls for f in ("--target", u)])),
+        daemon=True,
+    )
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    got = {}
+    for path in paths:
+        for _ in range(50):  # wait for the listener
+            try:
+                with urllib.request.urlopen(base + path, timeout=5) as resp:
+                    got[path] = resp.read()
+                break
+            except OSError:
+                import time
+
+                time.sleep(0.05)
+        else:
+            pytest.fail(f"collector never answered {path}")
+    t.join(timeout=10)
+    assert rc == [0]
+    metrics = got["/metrics"].decode()
+    # counters summed across both replicas, merged histogram intact
+    assert "engine_requests_completed_total 20" in metrics
+    assert "ttft_seconds_count 4" in metrics
+    assert "collector_fleet_targets_up 2" in metrics
+    health = json.loads(got["/healthz"])
+    assert health["ok"] and health["up"] == 2
+    fleet = json.loads(got["/debug/fleet"])
+    assert len(fleet["targets"]) == 2
+    assert fleet["fleet"]["tok_s"] == pytest.approx(80.0)
+    assert fleet["hpa"]["metrics"]
+    events = json.loads(got["/debug/events?limit=10"])
+    assert events["events"] and events["events"][0]["target"]
+    trace = json.loads(got[f"/debug/trace?trace_id={TRACE}"])
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(lanes) == 2  # one lane per replica process
+
+
+def test_collector_serve_requires_targets(capsys):
+    assert main(["collector", "serve"]) == 1
+    assert "no targets" in capsys.readouterr().out
+
+
+# -- top --fleet -------------------------------------------------------------
+FLEET_DOC = {
+    "fleet": {"targets": 3, "up": 2, "quarantined": 1, "tok_s": 85.0,
+              "active_slots": 4.0, "max_slots": 8.0, "queued": 2.0},
+    "targets": [
+        {"target": "replica0:8000", "url": "http://replica0:8000", "up": True,
+         "staleness_s": 1.2, "tok_s": 42.5, "active_slots": 2.0,
+         "max_slots": 4.0, "queued": 1.0, "occupancy": 1.71, "slo": "ok"},
+        {"target": "replica1:8000", "url": "http://replica1:8000", "up": True,
+         "staleness_s": 0.8, "tok_s": 42.5, "active_slots": 2.0,
+         "max_slots": 4.0, "queued": 1.0, "occupancy": 0.4, "slo": "warn"},
+        {"target": "replica2:8000", "url": "http://replica2:8000",
+         "up": False, "quarantined": True, "staleness_s": 93.0,
+         "tok_s": None, "slo": None},
+    ],
+    "slo": {"ready": False, "status": "breach", "slos": [
+        {"name": "ttft_p99", "status": "breach",
+         "burn_short": 8.0, "burn_long": 7.0},
+    ]},
+    "notes": ["histogram bucket-edge mismatch for ttft_seconds"],
+    "hpa": {"metrics": []},
+}
+
+FLEET_EVENTS = {"events": [
+    {"time": 1754500000.0, "seq": 4, "level": "error", "subsystem": "engine",
+     "event": "request_failed", "target": "replica1:8000",
+     "reason": "decode failed"},
+]}
+
+
+class CollectorStubHandler(BaseHTTPRequestHandler):
+    omit = ()
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?")[0]
+        payloads = {
+            "/debug/fleet": json.dumps(FLEET_DOC).encode(),
+            "/debug/events": json.dumps(FLEET_EVENTS).encode(),
+            "/metrics": b"collector_fleet_targets 3\n",
+            "/debug/trace": json.dumps(
+                {"traceEvents": [], "stitched": True}).encode(),
+        }
+        if path in self.omit or path not in payloads:
+            self.send_error(404)
+            return
+        body = payloads[path]
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def collector_url():
+    server, url = _start(CollectorStubHandler)
+    try:
+        yield url
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_top_fleet_renders_matrix(collector_url, capsys):
+    rc = main(["top", "--fleet", "--url", collector_url, "--iterations", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "top — fleet" in out
+    assert "FLEET  2/3 up  (1 quarantined)" in out
+    assert "replica0:8000" in out and "replica2:8000" in out
+    assert "QUAR" in out  # quarantined row flagged
+    assert "42.5" in out and "1.71" in out
+    assert "FLEET SLO" in out and "breach" in out
+    assert "!! FLEET NOT READY" in out
+    assert "note: histogram bucket-edge mismatch" in out
+    assert "[replica1:8000]" in out  # merged events carry their origin
+    assert "reason=decode failed" in out
+    assert "seq=" not in out  # envelope keys pruned from event lines
+
+
+def test_top_fleet_survives_missing_events(collector_url, capsys, monkeypatch):
+    monkeypatch.setattr(CollectorStubHandler, "omit", ("/debug/events",))
+    assert main(["top", "--fleet", "--url", collector_url,
+                 "--iterations", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "FLEET  2/3 up" in out
+    assert "RECENT EVENTS" not in out
+
+
+def test_top_fleet_no_collector_fails(capsys):
+    rc = main(["top", "--fleet", "--url", "http://127.0.0.1:9",
+               "--iterations", "1"])
+    assert rc == 1
+    assert "no collector endpoint" in capsys.readouterr().out
+
+
+# -- debug bundle --fleet ----------------------------------------------------
+def test_debug_bundle_explicit_targets_with_partial_failure(
+        replica_urls, tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(
+        ReplicaHandler, "omit", ("/debug/requests", "/debug/spans"))
+    out = str(tmp_path / "fleet.tar.gz")
+    rc = main(["debug", "bundle", "--fleet", "--out", out, "--seconds", "0"]
+              + [f for u in replica_urls for f in ("--target", u)])
+    assert rc == 0
+    with tarfile.open(out, "r:gz") as tar:
+        names = sorted(tar.getnames())
+        manifest = json.load(tar.extractfile("bundle/manifest.json"))
+        assert manifest["fleet"] is True
+        assert len(manifest["targets"]) == 2
+        for safe, entry in manifest["targets"].items():
+            assert f"bundle/{safe}/metrics.txt" in names
+            assert f"bundle/{safe}/healthz.json" in names
+            assert f"bundle/{safe}/events.json" in names
+            # the 404ed members are recorded, not fatal
+            assert set(entry["errors"]) == {"requests.json", "spans.json"}
+            assert f"bundle/{safe}/requests.json" not in names
+        metrics = tar.extractfile(
+            "bundle/" + sorted(manifest["targets"])[0] + "/metrics.txt"
+        ).read().decode()
+        assert "engine_tokens_per_sec_10s" in metrics
+    assert "2 target(s)" in capsys.readouterr().out
+
+
+def test_debug_bundle_fleet_discovers_targets_from_collector(
+        replica_urls, tmp_path):
+    doc = dict(FLEET_DOC)
+    doc["targets"] = [
+        {"target": f"replica{i}", "url": u, "up": True}
+        for i, u in enumerate(replica_urls)
+    ]
+
+    class DiscoveryHandler(CollectorStubHandler):
+        def do_GET(self):  # noqa: N802
+            path = self.path.split("?")[0]
+            if path == "/debug/fleet":
+                body = json.dumps(doc).encode()
+            elif path == "/metrics":
+                body = b"collector_fleet_targets 2\n"
+            elif path == "/debug/trace":
+                body = json.dumps({"traceEvents": []}).encode()
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server, url = _start(DiscoveryHandler)
+    try:
+        out = str(tmp_path / "fleet.tar.gz")
+        rc = main(["debug", "bundle", "--fleet", "--url", url,
+                   "--out", out, "--seconds", "0"])
+        assert rc == 0
+        with tarfile.open(out, "r:gz") as tar:
+            names = sorted(tar.getnames())
+            # collector-level evidence rides along
+            assert "bundle/fleet.json" in names
+            assert "bundle/fleet_metrics.txt" in names
+            assert "bundle/fleet_trace.json" in names
+            assert "bundle/replica0/metrics.txt" in names
+            assert "bundle/replica1/metrics.txt" in names
+            fleet = json.load(tar.extractfile("bundle/fleet.json"))
+            assert len(fleet["targets"]) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_debug_bundle_fleet_no_targets_fails(capsys):
+    rc = main(["debug", "bundle", "--fleet", "--url", "http://127.0.0.1:9",
+               "--out", "/tmp/never.tar.gz", "--seconds", "0"])
+    assert rc == 1
+    assert "no collector endpoint" in capsys.readouterr().out

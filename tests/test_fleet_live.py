@@ -1,0 +1,177 @@
+"""Live fleet federation demo (ISSUE 10 acceptance, slow).
+
+Boots three real serving replicas (examples/llama-inference/serve.py,
+TINY model, CPU), drives one /generate through each, then runs the real
+``TelemetryCollector`` over actual HTTP against them: the fleet
+/metrics exposition carries summed counters and the bucket-merged TTFT
+histogram, ``top --fleet`` renders the matrix, killing a replica flips
+its staleness gauge without breaking the snapshot, and a traced request
+(same W3C ``traceparent`` fanned to two replicas) shows up in one
+stitched Chrome trace with a distinct process lane per worker.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from devspace_tpu.cli.main import main
+from devspace_tpu.obs.collector import TelemetryCollector, make_http_server
+from devspace_tpu.obs.fleet import parse_exposition
+from devspace_tpu.utils import log as logutil
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+SERVE = os.path.join(REPO, "examples", "llama-inference", "serve.py")
+
+TRACE = "fe" * 16
+PARENT = "aa" * 8
+
+
+def _post(url, body, headers=None, timeout=240):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _spawn_replica(port):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        MODEL="tiny",
+        MAX_SLOTS="2",
+        PORT=str(port),
+    )
+    return subprocess.Popen(
+        [sys.executable, SERVE],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+
+
+@pytest.mark.slow
+def test_fleet_collector_live_three_replicas(capsys):
+    logutil.set_logger(logutil.StdoutLogger())
+    ports = [18561, 18562, 18563]
+    procs = [_spawn_replica(p) for p in ports]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    httpd = None
+    collector = None
+    try:
+        deadline = time.monotonic() + 180
+        pending = set(ports)
+        while pending and time.monotonic() < deadline:
+            for port, proc in zip(ports, procs):
+                if port not in pending:
+                    continue
+                try:
+                    with socket.create_connection(
+                            ("127.0.0.1", port), timeout=1):
+                        pending.discard(port)
+                except OSError:
+                    if proc.poll() is not None:
+                        pytest.fail(
+                            f"replica :{port} died: "
+                            f"{proc.stdout.read()[-2000:]}")
+            time.sleep(0.3)
+        if pending:
+            pytest.fail(f"replicas never opened: {sorted(pending)}")
+
+        # one generate per replica; the SAME distributed trace fans out
+        # to the first two so the stitched view spans two processes
+        traceparent = f"00-{TRACE}-{PARENT}-01"
+        for i, u in enumerate(urls):
+            g = _post(
+                u + "/generate",
+                {"prompt_ids": [5, 1, 4], "max_new_tokens": 4},
+                headers={"traceparent": traceparent} if i < 2 else None,
+            )
+            assert len(g["tokens"]) == 4
+
+        collector = TelemetryCollector.from_replicas(urls, interval_s=30.0)
+        collector.scrape_once()
+        assert all(t.up for t in collector.targets)
+
+        # -- fleet /metrics: summed counters, bucket-merged histogram --
+        httpd = make_http_server(collector, "127.0.0.1", 0)
+        import threading
+
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        snap = parse_exposition(text)
+        assert snap["engine_requests_completed_total"][
+            "samples"][0][1] == 3.0
+        ttft = snap["ttft_seconds"]["samples"][0][1]
+        assert ttft["count"] == 3  # one observation per replica, merged
+        assert snap["collector_fleet_targets_up"]["samples"][0][1] == 3.0
+
+        # -- top --fleet renders the matrix over the live collector --
+        assert main(["top", "--fleet", "--url", base,
+                     "--iterations", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "FLEET  3/3 up" in out
+        for port in ports:
+            assert f"127.0.0.1:{port}" in out
+
+        # -- stitched Chrome trace: one lane per replica process --
+        with urllib.request.urlopen(
+                base + f"/debug/trace?trace_id={TRACE}", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(lanes) >= 2  # distinct process lanes
+        assert len({e["pid"] for e in xs}) >= 2
+        assert all(e["args"]["trace_id"] == TRACE for e in xs)
+
+        # -- kill a replica: staleness flips, snapshot survives --
+        procs[2].terminate()
+        procs[2].wait(timeout=30)
+        time.sleep(0.5)
+        collector.scrape_once()
+        dead = next(t for t in collector.targets
+                    if str(ports[2]) in t.name)
+        assert not dead.up
+        snap2 = collector.fleet_snapshot()
+        stale = {l["target"]: v for l, v in
+                 snap2["collector_target_staleness_seconds"]["samples"]}
+        assert stale[dead.name] > 0
+        assert snap2["collector_fleet_targets_up"]["samples"][0][1] == 2.0
+        # the dead replica's last-known counters still federate
+        assert snap2["engine_requests_completed_total"][
+            "samples"][0][1] == 3.0
+        assert "collector_target_staleness_seconds" in (
+            collector.render_metrics())
+
+        assert main(["top", "--fleet", "--url", base,
+                     "--iterations", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "FLEET  2/3 up" in out
+        assert "DOWN" in out
+    finally:
+        if collector is not None:
+            collector.stop()
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()

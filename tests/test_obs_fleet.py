@@ -1,0 +1,480 @@
+"""Fleet telemetry federation tests (ISSUE 10).
+
+Pure-Python coverage of obs/fleet.py + obs/collector.py: exposition
+round-trip, the hand-computed three-worker histogram merge golden,
+aggregation-hint gauge semantics, fleet-SLO breach parity (merged
+buckets vs one process emitting the union of events), trace stitching,
+HPA-convention export, and the chaos ladder (hard-down target, garbage
+exposition -> quarantine). The live 3-replica demo is the slow-marked
+test in test_fleet_live.py; the CLI/HTTP surface is test_cli_fleet.py.
+"""
+
+import math
+
+import pytest
+
+from devspace_tpu.obs.collector import (
+    COLLECTOR_METRIC_FAMILIES,
+    TelemetryCollector,
+)
+from devspace_tpu.obs.fleet import (
+    DEFAULT_AGG,
+    ExpositionParseError,
+    aggregation_hints,
+    family_agg,
+    merge_snapshots,
+    parse_exposition,
+    stitch_chrome_trace,
+)
+from devspace_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Registry,
+    render_snapshot,
+)
+from devspace_tpu.obs.slo import SLOEvaluator, SLOSpec
+
+EDGES = list(DEFAULT_LATENCY_BUCKETS) + [float("inf")]
+
+
+# -- exposition round-trip ---------------------------------------------------
+def _sample_registry():
+    r = Registry()
+    r.counter("engine_requests_completed_total", "done").inc(7)
+    r.gauge("engine_tokens_per_sec_10s", "rate").set(12.5)
+    g = r.gauge("slo_status", "state", labels=("slo",))
+    g.labels(slo="ttft_p99").set(2)
+    g.labels(slo='we"ird\\label').set(1)
+    h = r.histogram("ttft_seconds", "ttft")
+    h.observe(0.002)
+    h.observe(0.3)
+    return r
+
+
+def test_parse_exposition_round_trip():
+    reg = _sample_registry()
+    snap = parse_exposition(reg.render())
+    orig = reg.snapshot()
+    assert snap["engine_requests_completed_total"]["kind"] == "counter"
+    assert snap["engine_requests_completed_total"]["samples"] == [({}, 7.0)]
+    assert snap["engine_tokens_per_sec_10s"]["samples"] == [({}, 12.5)]
+    labels = {l["slo"]: v for l, v in snap["slo_status"]["samples"]}
+    assert labels == {"ttft_p99": 2.0, 'we"ird\\label': 1.0}
+    hist = snap["ttft_seconds"]["samples"][0][1]
+    want = orig["ttft_seconds"]["samples"][0][1]
+    assert hist["count"] == want["count"] == 2
+    assert hist["sum"] == pytest.approx(want["sum"])
+    assert [le for le, _ in hist["buckets"]] == EDGES
+    assert [c for _, c in hist["buckets"]] == [c for _, c in want["buckets"]]
+    # render(parse(render())) is a fixed point
+    assert render_snapshot(snap) == render_snapshot(parse_exposition(
+        render_snapshot(snap)))
+
+
+def test_parse_rejects_garbage_and_truncation():
+    with pytest.raises(ExpositionParseError):
+        parse_exposition("this is not { an exposition !!!")
+    # a histogram cut off before its _sum/_count series must not merge
+    reg = _sample_registry()
+    text = reg.render()
+    cut = text[: text.index("ttft_seconds_sum")]
+    with pytest.raises(ExpositionParseError):
+        parse_exposition(cut)
+    # non-cumulative buckets are nonsense
+    bad = (
+        "# TYPE x_seconds histogram\n"
+        'x_seconds_bucket{le="0.1"} 5\n'
+        'x_seconds_bucket{le="+Inf"} 3\n'
+        "x_seconds_sum 1.0\n"
+        "x_seconds_count 3\n"
+    )
+    with pytest.raises(ExpositionParseError):
+        parse_exposition(bad)
+
+
+def test_parse_untyped_falls_back_on_name_convention():
+    snap = parse_exposition("foo_total 3\nbar_depth 2\n")
+    assert snap["foo_total"]["kind"] == "counter"
+    assert snap["bar_depth"]["kind"] == "gauge"
+
+
+# -- the hand-computed three-worker histogram merge golden -------------------
+def _hist_snap(observations):
+    h = Registry().histogram("ttft_seconds", "ttft")
+    for v in observations:
+        h.observe(v)
+    return {
+        "ttft_seconds": {
+            "kind": "histogram", "help": "ttft",
+            "samples": [({}, h.snapshot())],
+        }
+    }
+
+
+def test_histogram_merge_three_workers_golden():
+    a = _hist_snap([0.002, 0.04])          # worker A
+    b = _hist_snap([0.0009, 0.2, 0.7])     # worker B
+    c = _hist_snap([3.0])                  # worker C
+    merged, notes = merge_snapshots([a, b, c], hints={})
+    assert notes == []
+    got = merged["ttft_seconds"]["samples"][0][1]
+    # hand-computed cumulative counts per DEFAULT_LATENCY_BUCKETS edge:
+    # 0.001: B's 0.0009                                    -> 1
+    # 0.0025: + A's 0.002                                  -> 2
+    # 0.05:   + A's 0.04                                   -> 3
+    # 0.25:   + B's 0.2                                    -> 4
+    # 1.0:    + B's 0.7                                    -> 5
+    # 5.0:    + C's 3.0                                    -> 6
+    golden = [
+        (0.001, 1), (0.0025, 2), (0.005, 2), (0.01, 2), (0.025, 2),
+        (0.05, 3), (0.1, 3), (0.25, 4), (0.5, 4), (1.0, 5),
+        (2.5, 5), (5.0, 6), (10.0, 6), (30.0, 6), (60.0, 6),
+        (float("inf"), 6),
+    ]
+    assert got["buckets"] == [(le, float(c)) for le, c in golden]
+    assert got["count"] == 6
+    assert got["sum"] == pytest.approx(0.002 + 0.04 + 0.0009 + 0.2 + 0.7 + 3.0)
+    # the merge must equal one histogram observing the union of events
+    union = _hist_snap([0.002, 0.04, 0.0009, 0.2, 0.7, 3.0])
+    assert got["buckets"] == union["ttft_seconds"]["samples"][0][1]["buckets"]
+
+
+def test_histogram_merge_rejects_mismatched_edges():
+    a = _hist_snap([0.01])
+    h = Registry().histogram("ttft_seconds", "ttft", buckets=(0.5, 1.0))
+    h.observe(0.7)
+    b = {"ttft_seconds": {"kind": "histogram", "help": "ttft",
+                          "samples": [({}, h.snapshot())]}}
+    merged, notes = merge_snapshots([a, b], hints={})
+    assert any("bucket-edge mismatch" in n for n in notes)
+    # first-seen edges win; the divergent series is dropped, not mixed in
+    assert merged["ttft_seconds"]["samples"][0][1]["count"] == 1
+
+
+# -- gauge aggregation hints -------------------------------------------------
+def _gauge_snap(name, value):
+    return {name: {"kind": "gauge", "help": "g", "samples": [({}, value)]}}
+
+
+@pytest.mark.parametrize(
+    "hint,values,want",
+    [("sum", [1.0, 2.0, 4.0], 7.0),
+     ("max", [1.0, 5.0, 3.0], 5.0),
+     ("avg", [1.0, 2.0, 6.0], 3.0),
+     ("last", [1.0, 2.0, 6.0], 6.0)],
+)
+def test_gauge_merge_per_hint(hint, values, want):
+    snaps = [_gauge_snap("g_depth", v) for v in values]
+    merged, _notes = merge_snapshots(snaps, hints={"g_depth": hint})
+    assert merged["g_depth"]["samples"][0][1] == pytest.approx(want)
+
+
+def test_counters_always_sum_and_unknown_gauges_note_fallback():
+    snaps = [
+        {"c_total": {"kind": "counter", "help": "c", "samples": [({}, 2.0)]},
+         **_gauge_snap("mystery_depth", 1.0)},
+        {"c_total": {"kind": "counter", "help": "c", "samples": [({}, 3.0)]},
+         **_gauge_snap("mystery_depth", 2.0)},
+    ]
+    merged, notes = merge_snapshots(snaps, hints={})
+    assert merged["c_total"]["samples"][0][1] == 5.0
+    assert any("mystery_depth" in n and DEFAULT_AGG in n for n in notes)
+
+
+def test_labeled_series_merge_per_label_set():
+    a = {"slo_status": {"kind": "gauge", "help": "s", "samples": [
+        ({"slo": "ttft"}, 0.0), ({"slo": "err"}, 2.0)]}}
+    b = {"slo_status": {"kind": "gauge", "help": "s", "samples": [
+        ({"slo": "ttft"}, 1.0)]}}
+    merged, _ = merge_snapshots([a, b], hints={"slo_status": "max"})
+    got = {l["slo"]: v for l, v in merged["slo_status"]["samples"]}
+    assert got == {"ttft": 1.0, "err": 2.0}
+
+
+def test_every_declared_hint_is_valid_and_collector_families_declared():
+    hints = aggregation_hints()
+    # all 9 catalogs imported in this environment
+    assert hints["engine_requests_completed_total"] == "sum"
+    assert hints["engine_dispatch_depth_occupancy"] == "avg"
+    assert hints["engine_uptime_seconds"] == "max"
+    assert hints["slo_status"] == "max"
+    assert hints["ttft_seconds"] == "sum"
+    for fam in COLLECTOR_METRIC_FAMILIES:
+        assert family_agg(fam) in ("sum", "max", "avg", "last")
+    with pytest.raises(ValueError):
+        family_agg(("x_total", "counter", "help with no hint"))
+
+
+# -- fleet SLO parity: merged buckets == union-of-events ---------------------
+def test_fleet_slo_burn_parity_with_union_process():
+    spec = SLOSpec(
+        name="ttft_p99", kind="latency", objective=0.99,
+        histogram="ttft_seconds", threshold_s=0.25,
+        short_window_s=60.0, long_window_s=300.0,
+    )
+    workers = [Registry() for _ in range(3)]
+    hists = [r.histogram("ttft_seconds", "ttft") for r in workers]
+    union_reg = Registry()
+    union_hist = union_reg.histogram("ttft_seconds", "ttft")
+
+    def fleet_source():
+        merged, _ = merge_snapshots([r.snapshot() for r in workers], hints={})
+        return merged
+
+    clock = {"now": 1000.0}
+    fleet_eval = SLOEvaluator([spec], [fleet_source],
+                              clock=lambda: clock["now"])
+    union_eval = SLOEvaluator([spec], [union_reg.snapshot],
+                              clock=lambda: clock["now"])
+    fleet_eval.evaluate()
+    union_eval.evaluate()
+    # per-worker traffic: worker 0 healthy, 1 mixed, 2 slow
+    traffic = [
+        [0.01, 0.02, 0.05],
+        [0.1, 0.6],
+        [1.2, 2.0, 3.0, 0.02],
+    ]
+    for worker_obs, hist in zip(traffic, hists):
+        for v in worker_obs:
+            hist.observe(v)
+            union_hist.observe(v)
+    clock["now"] += 30.0
+    f = {s.name: s for s in fleet_eval.evaluate()}["ttft_p99"]
+    u = {s.name: s for s in union_eval.evaluate()}["ttft_p99"]
+    assert f.status == u.status == "breach"  # 5/9 above threshold >> budget
+    assert f.burn_short == pytest.approx(u.burn_short)
+    assert f.burn_long == pytest.approx(u.burn_long)
+
+
+# -- collector ---------------------------------------------------------------
+def _fake_fleet(metrics_by_url, events_by_url=None, spans_by_url=None,
+                health_by_url=None):
+    """fetch(url, timeout) over canned per-target documents."""
+    events_by_url = events_by_url or {}
+    spans_by_url = spans_by_url or {}
+    health_by_url = health_by_url or {}
+
+    def fetch(url, timeout):
+        import json
+
+        base, _, path = url.partition("/")
+        for known in metrics_by_url:
+            if url.startswith(known + "/"):
+                path = url[len(known):]
+                if path.startswith("/metrics"):
+                    doc = metrics_by_url[known]
+                    if isinstance(doc, Exception):
+                        raise doc
+                    return doc.encode()
+                if path.startswith("/debug/events"):
+                    return json.dumps(
+                        {"events": events_by_url.get(known, [])}).encode()
+                if path.startswith("/debug/spans"):
+                    return json.dumps(
+                        {"spans": spans_by_url.get(known, [])}).encode()
+                if path.startswith("/healthz"):
+                    return json.dumps(
+                        health_by_url.get(known, {"ok": True})).encode()
+        raise OSError(f"unknown target {url}")
+
+    return fetch
+
+
+def _mk_collector(metrics_by_url, clock=None, **kw):
+    return TelemetryCollector(
+        sorted(metrics_by_url),
+        fetch=_fake_fleet(metrics_by_url, **kw.pop("docs", {})),
+        clock=clock or (lambda: 0.0),
+        **kw,
+    )
+
+
+def test_collector_federates_counters_and_histograms():
+    texts = {}
+    for i, obs in enumerate(([0.002, 0.04], [0.0009, 0.2, 0.7], [3.0])):
+        r = Registry()
+        r.counter("engine_requests_completed_total", "done").inc(10 * (i + 1))
+        h = r.histogram("ttft_seconds", "ttft")
+        for v in obs:
+            h.observe(v)
+        texts[f"http://replica{i}:8000"] = r.render()
+    c = _mk_collector(texts)
+    c.scrape_once()
+    snap = c.fleet_snapshot()
+    assert snap["engine_requests_completed_total"]["samples"][0][1] == 60.0
+    hist = snap["ttft_seconds"]["samples"][0][1]
+    assert hist["count"] == 6
+    assert snap["collector_fleet_targets_up"]["samples"][0][1] == 3.0
+    # the exposition of the fleet snapshot parses right back
+    assert parse_exposition(c.render_metrics())["ttft_seconds"][
+        "samples"][0][1]["count"] == 6
+
+
+@pytest.mark.chaos
+def test_collector_target_hard_down_degrades_to_staleness():
+    """Chaos: one target dead. Its staleness gauge is set (and up=0),
+    the other targets still federate, and the fleet snapshot renders —
+    the collector never fails because a target did."""
+    clock = {"now": 100.0}
+    good = Registry()
+    good.counter("engine_requests_completed_total", "done").inc(5)
+    texts = {
+        "http://up:8000": good.render(),
+        "http://dead:8000": OSError("connection refused"),
+    }
+    c = _mk_collector(texts, clock=lambda: clock["now"])
+    c.scrape_once()
+    dead = next(t for t in c.targets if "dead" in t.name)
+    up = next(t for t in c.targets if t.name == "up:8000")
+    assert not dead.up and up.up
+    clock["now"] += 60.0
+    snap = c.fleet_snapshot()
+    assert snap["engine_requests_completed_total"]["samples"][0][1] == 5.0
+    by_target = {l["target"]: v for l, v in
+                 snap["collector_target_up"]["samples"]}
+    assert by_target == {"dead:8000": 0.0, "up:8000": 1.0}
+    stale = {l["target"]: v for l, v in
+             snap["collector_target_staleness_seconds"]["samples"]}
+    assert math.isinf(stale["dead:8000"])  # never scraped OK
+    assert stale["up:8000"] == pytest.approx(60.0)
+    assert snap["collector_scrape_errors_total"]["samples"][0][1] == 1.0
+    # and the whole thing still renders as one well-formed exposition
+    assert "collector_target_staleness_seconds" in c.render_metrics()
+
+
+@pytest.mark.chaos
+def test_collector_garbage_exposition_quarantines_never_raises():
+    """Chaos: a target returns truncated/garbage exposition text. Every
+    bad round counts a parse error; after quarantine_after consecutive
+    failures the target is quarantined (excluded from the merge), and a
+    later clean parse readmits it. Nothing ever raises."""
+    good = Registry()
+    good.counter("engine_requests_completed_total", "done").inc(5)
+    docs = {"http://liar:8000": "garbage {{{ not metrics",
+            "http://up:8000": good.render()}
+    c = TelemetryCollector(
+        sorted(docs), clock=lambda: 0.0, quarantine_after=2,
+        fetch=lambda url, _t: (
+            docs[url[: url.index("/metrics")]].encode()
+            if url.endswith("/metrics") else (_ for _ in ()).throw(
+                OSError("no sidecar"))
+        ),
+    )
+    c.scrape_once()
+    liar = next(t for t in c.targets if "liar" in t.name)
+    assert not liar.up and not liar.quarantined  # 1 of 2 strikes
+    c.scrape_once()
+    assert liar.quarantined
+    snap = c.fleet_snapshot()
+    assert snap["collector_parse_errors_total"]["samples"][0][1] == 2.0
+    assert snap["engine_requests_completed_total"]["samples"][0][1] == 5.0
+    by_target = {l["target"]: v for l, v in
+                 snap["collector_target_quarantined"]["samples"]}
+    assert by_target["liar:8000"] == 1.0
+    # the liar starts telling the truth -> readmitted next round
+    docs["http://liar:8000"] = good.render()
+    c.scrape_once()
+    assert not liar.quarantined and liar.up
+    assert c.fleet_snapshot()["engine_requests_completed_total"][
+        "samples"][0][1] == 10.0
+
+
+def test_collector_merged_events_stable_order_and_target_stamp():
+    texts = {u: Registry().render() or "# empty\n"
+             for u in ("http://a:1", "http://b:1")}
+    events = {
+        "http://a:1": [
+            {"time": 5.0, "seq": 2, "subsystem": "engine", "event": "admit"},
+            {"time": 7.0, "seq": 9, "subsystem": "engine", "event": "admit"},
+        ],
+        "http://b:1": [
+            {"time": 5.0, "seq": 1, "subsystem": "slo", "event": "warn"},
+        ],
+    }
+    c = _mk_collector(texts, docs={"events_by_url": events})
+    c.scrape_once()
+    merged = c.merged_events()
+    assert [(e["time"], e["seq"], e["target"]) for e in merged] == [
+        (5.0, 1, "b:1"), (5.0, 2, "a:1"), (7.0, 9, "a:1")]
+    assert c.merged_events(subsystem="slo")[0]["event"] == "warn"
+
+
+def test_stitched_trace_one_lane_per_process():
+    tid = "ab" * 16
+    spans = {
+        "http://a:1": [
+            {"name": "generate", "trace_id": tid, "span_id": "11" * 8,
+             "start": 10.0, "duration_s": 0.5, "track": "http"},
+            {"name": "other", "trace_id": "ff" * 16, "span_id": "33" * 8,
+             "start": 11.0, "duration_s": 0.1, "track": "http"},
+        ],
+        "http://b:1": [
+            {"name": "decode", "trace_id": tid, "span_id": "22" * 8,
+             "parent_span_id": "11" * 8, "start": 10.1, "duration_s": 0.3,
+             "track": "engine"},
+        ],
+    }
+    doc = stitch_chrome_trace(spans, trace_id=tid)
+    pids = {e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert set(pids) == {"http://a:1", "http://b:1"}
+    assert len(set(pids.values())) == 2  # distinct process lanes
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"generate", "decode"}  # filtered
+    gen = next(e for e in xs if e["name"] == "generate")
+    dec = next(e for e in xs if e["name"] == "decode")
+    assert gen["pid"] != dec["pid"]
+    assert dec["ts"] == pytest.approx(10.1e6)
+    assert dec["args"]["parent_span_id"] == "11" * 8
+    # collector plumbing produces the same document
+    texts = {u: "# empty\n" for u in spans}
+    c = _mk_collector(texts, docs={"spans_by_url": spans})
+    c.scrape_once()
+    via_collector = c.stitched_trace(tid)
+    assert {e["name"] for e in via_collector["traceEvents"]
+            if e["ph"] == "X"} == {"generate", "decode"}
+
+
+def test_hpa_signals_follow_chart_convention():
+    texts = {}
+    for i, (occ, queued) in enumerate([(1.0, 2), (3.0, 4)]):
+        r = Registry()
+        r.gauge("engine_dispatch_depth_occupancy", "occ").set(occ)
+        r.gauge("engine_queued_requests", "q").set(queued)
+        r.gauge("engine_tokens_per_sec_10s", "rate").set(10.0)
+        texts[f"http://r{i}:1"] = r.render()
+    c = _mk_collector(texts)
+    c.scrape_once()
+    metrics = c.hpa_signals()
+    # exactly the autoscaling/v2 entry shape chart.py's
+    # values.autoscaling.objects carries
+    by_name = {m["pods"]["metric"]["name"]: m for m in metrics}
+    occ = by_name["engine_dispatch_depth_occupancy"]
+    assert occ["type"] == "Pods"
+    assert occ["pods"]["target"]["type"] == "AverageValue"
+    assert occ["pods"]["target"]["averageValue"] == pytest.approx(2.0)
+    assert by_name["engine_queued_requests"]["pods"]["target"][
+        "averageValue"] == pytest.approx(3.0)
+    status = c.fleet_status()
+    assert status["hpa"]["metrics"] == metrics
+
+
+def test_fleet_status_matrix_rows():
+    r = Registry()
+    r.gauge("engine_tokens_per_sec_10s", "rate").set(42.5)
+    r.gauge("engine_active_slots", "a").set(3)
+    r.gauge("engine_max_slots", "m").set(4)
+    r.gauge("engine_queued_requests", "q").set(1)
+    texts = {"http://solo:8000": r.render()}
+    c = _mk_collector(
+        texts,
+        docs={"health_by_url": {"http://solo:8000": {
+            "ok": True, "slo": {"status": "ok"}}}},
+    )
+    c.scrape_once()
+    status = c.fleet_status()
+    row = status["targets"][0]
+    assert row["target"] == "solo:8000" and row["up"]
+    assert row["tok_s"] == 42.5 and row["max_slots"] == 4.0
+    assert row["slo"] == "ok"
+    assert status["fleet"]["up"] == 1
+    assert status["slo"]["slos"]  # fleet evaluator ran

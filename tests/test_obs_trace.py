@@ -167,7 +167,7 @@ def test_export_jsonl_and_chrome(tmp_path):
 
 def test_catalog_matches_registered_families():
     tel = ServingTelemetry(clock=FakeClock())
-    assert tel.registry.names() == sorted(n for n, _, _ in SERVING_METRIC_FAMILIES)
+    assert tel.registry.names() == sorted(n for n, _, _, _ in SERVING_METRIC_FAMILIES)
 
 
 # -- utils/trace ring rotation + dropped counter ----------------------------
