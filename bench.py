@@ -353,6 +353,7 @@ def bench_serving() -> dict:
         # same sitecustomize workaround as the other children
         jax.config.update("jax_platforms", "cpu")
     from devspace_tpu.inference import InferenceEngine
+    from devspace_tpu.lint.runtime import CompileWatch
     from devspace_tpu.models import transformer as tfm
 
     platform = jax.devices()[0].platform
@@ -374,6 +375,11 @@ def bench_serving() -> dict:
         for _ in range(n_req)
     ]
 
+    # every timed wave runs under CompileWatch: after the compile wave,
+    # any further XLA compile is a recompile bug (the PR 7 class) — the
+    # gate pins serving_recompiles_after_warmup at 0
+    wave_recompiles: list = []
+
     def wave(depth, label):
         hb(f"serving: {label} compile wave")
         engine = InferenceEngine(
@@ -389,10 +395,12 @@ def bench_serving() -> dict:
             time.sleep(0.5)
             before = engine.stats()
             hb(f"serving: {label} timed wave")
+            watch = CompileWatch(label).start()
             t0 = time.time()
             for h in [engine.submit(p, new_tokens) for p in prompts]:
                 h.result(timeout=600)
             elapsed = time.time() - t0
+            wave_recompiles.append((label, watch.stop()))
         finally:
             engine.stop()  # joins the loop; counters are final after this
         return elapsed, before, engine.stats()
@@ -536,6 +544,11 @@ def bench_serving() -> dict:
         "requests": n_req,
         "new_tokens": new_tokens,
         "platform": platform,
+        # total timed-wave compiles across all four serving waves — any
+        # nonzero value is a per-iteration recompile (must stay 0)
+        "serving_recompiles_after_warmup": sum(
+            n for _, n in wave_recompiles
+        ),
         "kv_pressure_tok_per_sec": round(p_total / pon_s, 1),
         "kv_pressure_off_tok_per_sec": round(p_total / poff_s, 1),
         "kv_pressure_speedup": round(poff_s / pon_s, 2),
@@ -558,7 +571,13 @@ def bench_serving() -> dict:
         f"-> {res['overlap_speedup']}x; occupancy "
         f"{res['dispatch_depth_occupancy']}, readback_wait "
         f"{res['readback_wait_s']}s, host_sched {res['host_sched_s']}s, "
-        f"carry_updates {res['carry_updates']}"
+        f"carry_updates {res['carry_updates']}, "
+        f"recompiles_after_warmup {res['serving_recompiles_after_warmup']}"
+        + (
+            " — RECOMPILE IN THE HOT PATH"
+            if res["serving_recompiles_after_warmup"]
+            else ""
+        )
     )
     log(
         f"[bench] serving metrics overhead: "

@@ -1785,11 +1785,17 @@ def cmd_lint(args) -> int:
     with its configured values (the exact deploy render path), run the
     rule engine over the rendered objects (structure, TPU slice
     invariants, image hygiene), and report as text, JSON, or SARIF."""
-    from ..lint import lint_chart_findings
+    from ..lint import (
+        filter_findings,
+        lint_chart_findings,
+        parse_rule_filter,
+    )
     from ..lint.project import collect_project_findings
 
     fmt = getattr(args, "format", None) or "text"
     strict = bool(getattr(args, "strict", False))
+    select = parse_rule_filter(getattr(args, "select", None))
+    ignore = parse_rule_filter(getattr(args, "ignore", None))
     if fmt != "text":
         # machine formats own stdout: push incidental log lines (backend
         # banner, render warnings) to stderr so the document stays valid
@@ -1797,7 +1803,9 @@ def cmd_lint(args) -> int:
     log = logutil.get_logger()
     if getattr(args, "chart", None):
         # standalone chart dir (no project config needed)
-        findings = lint_chart_findings(args.chart)
+        findings = filter_findings(
+            lint_chart_findings(args.chart), select, ignore
+        )
         for f in findings:
             if not f.artifact:
                 f.artifact = args.chart
@@ -1809,6 +1817,7 @@ def cmd_lint(args) -> int:
 
     ctx = Context(args)
     findings, n_objects = collect_project_findings(ctx)
+    findings = filter_findings(findings, select, ignore)
     _emit_lint_report(log, findings, fmt, n_objects)
     return _lint_exit_code(findings, strict)
 
@@ -2476,6 +2485,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--strict",
         action="store_true",
         help="exit non-zero on warnings too, not just errors",
+    )
+    sp.add_argument(
+        "--select",
+        help="only report these rule ids / family prefixes "
+        "(comma-separated, e.g. DS1,TPU205)",
+    )
+    sp.add_argument(
+        "--ignore",
+        help="drop these rule ids / family prefixes (applied after "
+        "--select; ignore wins on overlap)",
     )
     sp.set_defaults(fn=cmd_lint)
 

@@ -1399,8 +1399,11 @@ class InferenceEngine:
             self._stop.set()
         if self._thread:
             self._thread.join(timeout=30)
-        with self._submit_lock:
-            self._fail_outstanding("engine stopped")
+        # _stop is set under the lock above, so no submit() can enqueue
+        # past this point — failing outstanding work OUTSIDE the lock
+        # keeps late submitters failing fast instead of stalling behind
+        # per-request teardown (telemetry, event sinks, stream wakeups).
+        self._fail_outstanding("engine stopped")
 
     # -- block allocator ---------------------------------------------------
     def _blocks_needed(self, slot_idx: int, upto: int) -> int:
@@ -1477,8 +1480,9 @@ class InferenceEngine:
             kq, ks, vq, vs = self._gather_chain_jit(
                 self.pool, jnp.asarray(idx, jnp.int32)
             )
-            kq, ks = np.asarray(kq), np.asarray(ks)
-            vq, vs = np.asarray(vq), np.asarray(vs)
+            # single readback for all four arrays — the one designed
+            # device sync per batch, not four
+            kq, ks, vq, vs = jax.device_get((kq, ks, vq, vs))  # lint: allow(JIT502)
             for n, (digest, _) in enumerate(group):
                 payload = pack_kv_payload(
                     kq[:, n], ks[:, n], vq[:, n], vs[:, n]

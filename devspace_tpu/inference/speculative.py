@@ -316,8 +316,10 @@ def generate_speculative(
         )
         t_cache = {"k": t_kv["k"], "v": t_kv["v"], "length": t_cache["length"]}
 
-        props_h = np.asarray(props)
-        choices_h = np.asarray(choices)
+        # one readback per round for both arrays — the accept/reject
+        # decision is host-side by design; two np.asarray calls here
+        # were two blocking transfers where one suffices
+        props_h, choices_h = jax.device_get((props, choices))  # lint: allow(JIT502)
         match = props_h == choices_h[:, :k]  # [B, k]
         accepts = np.where(
             match.all(axis=1), k, match.argmin(axis=1)

@@ -35,7 +35,15 @@ from . import rules_manifest  # noqa: E402,F401
 from . import rules_tpu  # noqa: E402,F401
 from . import rules_sharding  # noqa: E402,F401
 from . import rules_docker  # noqa: E402,F401
+from . import pysource  # noqa: E402,F401  (PY500)
+from . import rules_hotpath  # noqa: E402,F401  (JIT5xx)
+from . import rules_concurrency  # noqa: E402,F401  (CON6xx)
+from . import rules_obs  # noqa: E402,F401  (OBS7xx)
 
+from .engine import filter_findings, parse_rule_filter, rule_selected
+from .pysource import collect_python_sources, lint_python_sources
+from .rules_concurrency import extract_lock_graph
+from .rules_obs import lint_obs_catalogs, load_metric_catalogs
 from .rules_docker import lint_dockerfile
 from .rules_sharding import (
     donation_preflight,
@@ -58,16 +66,24 @@ __all__ = [
     "LintContext",
     "Rule",
     "collect_project_findings",
+    "collect_python_sources",
     "count_by_severity",
     "donation_preflight",
+    "extract_lock_graph",
+    "filter_findings",
     "has_errors",
     "lint_chart_findings",
     "lint_docs",
     "lint_dockerfile",
+    "lint_obs_catalogs",
+    "lint_python_sources",
+    "load_metric_catalogs",
     "mesh_axes_for_tpu",
+    "parse_rule_filter",
     "render_failure",
     "reporters",
     "rule",
+    "rule_selected",
     "run_rules",
     "sharding_preflight",
 ]

@@ -31,6 +31,7 @@ class Finding:
     message: str
     location: str = ""  # logical location, e.g. "StatefulSet/slice"
     artifact: str = ""  # file / chart dir / deployment the finding is in
+    line: int = 0  # 1-based source line for file-backed findings (0 = n/a)
 
     def legacy(self) -> str:
         """The pre-engine string form (``KIND/name: message``) — the compat
@@ -38,10 +39,10 @@ class Finding:
         return f"{self.location}: {self.message}" if self.location else self.message
 
     def sort_key(self) -> tuple:
-        return (self.artifact, self.location, self.rule_id, self.message)
+        return (self.artifact, self.location, self.rule_id, self.message, self.line)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "rule": self.rule_id,
             "severity": self.severity,
             "category": self.category,
@@ -49,6 +50,9 @@ class Finding:
             "location": self.location,
             "artifact": self.artifact,
         }
+        if self.line:
+            d["line"] = self.line
+        return d
 
 
 @dataclass(frozen=True)
@@ -95,6 +99,17 @@ class LintContext:
     shardings: Optional[dict] = None
     # {"fn", "args", "kwargs", "donate_argnums"}
     donation: Optional[dict] = None
+    # [(relpath, source_text)] — Python modules for the AST rule packs
+    # (rules_hotpath / rules_concurrency); parsed once, cached on the
+    # context by lint.pysource.parsed_sources
+    python_sources: list = field(default_factory=list)
+    # {catalog label: (family_tuple, ...)} — *_METRIC_FAMILIES catalogs
+    # for the OBS7xx pack (rules_obs)
+    metric_catalogs: Optional[dict] = None
+    # [(subsystem, name, help)] — obs.events.EVENT_CATALOG entries
+    event_catalog: Optional[list] = None
+    # timeline lane names (obs.tracing catalog + dynamic decode lanes)
+    timeline_tracks: Optional[list] = None
     artifact: str = ""  # default artifact tag for produced findings
 
 
@@ -134,6 +149,38 @@ def run_rules(
                 )
             )
     return findings
+
+
+def parse_rule_filter(spec: Optional[str]) -> tuple:
+    """Parse a CLI ``--select``/``--ignore`` value: comma-separated rule
+    ids or id prefixes (``JIT``, ``CON6``, ``OBS703``). Whitespace is
+    tolerated; empty/None means "no filter"."""
+    if not spec:
+        return ()
+    return tuple(
+        p.strip().upper() for p in str(spec).split(",") if p.strip()
+    )
+
+
+def rule_selected(
+    rule_id: str, select: tuple = (), ignore: tuple = ()
+) -> bool:
+    """Prefix-match filtering: a rule is selected when it matches some
+    ``select`` prefix (or select is empty) and no ``ignore`` prefix.
+    ``ignore`` wins over ``select`` — the ratchet direction a CI gate
+    wants when turning rules on family by family."""
+    rid = rule_id.upper()
+    if any(rid.startswith(p) for p in ignore):
+        return False
+    return not select or any(rid.startswith(p) for p in select)
+
+
+def filter_findings(
+    findings: Iterable[Finding],
+    select: tuple = (),
+    ignore: tuple = (),
+) -> list[Finding]:
+    return [f for f in findings if rule_selected(f.rule_id, select, ignore)]
 
 
 def count_by_severity(findings: Iterable[Finding]) -> dict[str, int]:

@@ -99,6 +99,21 @@ def collect_project_findings(ctx) -> tuple[list[Finding], int]:
                 LintContext(dockerfiles=dockerfiles), categories={"image"}
             )
         )
+
+    # hot-path + concurrency analysis over the project's own Python —
+    # the JAX code this project deploys is exactly where a JIT recompile
+    # or lock-order hazard costs TPU time. Warnings don't gate deploy
+    # (only --strict / PY500 syntax errors do).
+    from .pysource import collect_python_sources
+
+    py_sources = collect_python_sources(ctx.root, subdirs=("",))
+    if py_sources:
+        findings.extend(
+            run_rules(
+                LintContext(python_sources=py_sources),
+                categories={"hotpath", "concurrency"},
+            )
+        )
     return findings, len(all_docs)
 
 

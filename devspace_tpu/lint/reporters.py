@@ -40,7 +40,8 @@ def to_text(findings: Iterable[Finding]) -> str:
     findings = _sorted(findings)
     lines = []
     for f in findings:
-        where = " ".join(p for p in (f.artifact, f.location) if p)
+        artifact = f"{f.artifact}:{f.line}" if f.artifact and f.line else f.artifact
+        where = " ".join(p for p in (artifact, f.location) if p)
         prefix = f"{f.severity.upper():7s} {f.rule_id}"
         lines.append(f"{prefix}  {where + ': ' if where else ''}{f.message}")
     counts = count_by_severity(findings)
@@ -96,9 +97,10 @@ def to_sarif(findings: Iterable[Finding]) -> dict:
         }
         location: dict = {}
         if f.artifact:
-            location["physicalLocation"] = {
-                "artifactLocation": {"uri": f.artifact}
-            }
+            physical: dict = {"artifactLocation": {"uri": f.artifact}}
+            if f.line:
+                physical["region"] = {"startLine": f.line}
+            location["physicalLocation"] = physical
         if f.location:
             location["logicalLocations"] = [{"name": f.location}]
         if location:

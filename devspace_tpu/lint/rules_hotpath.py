@@ -1,0 +1,540 @@
+"""Hot-path JAX rules (JIT5xx, category ``hotpath``).
+
+The exact bug classes that cost the serving arc real regressions — PR 7's
+Python-int pool index silently recompiled per block id and inverted an
+A/B until a bench caught it. All of them are visible in the AST, so they
+belong in the preflight, not in a post-bench flamegraph dive:
+
+- **JIT500** ``jax.jit`` called inside a loop: every iteration mints a
+  fresh jitted callable (new compile-cache key), so nothing ever hits the
+  cache — the closure-capture variant of the PR 7 bug.
+- **JIT501** a *varying* value in a ``static_argnums``/``static_argnames``
+  position of a jitted call inside a loop: one XLA compile per distinct
+  value. Constants are fine (that is what static args are for).
+- **JIT502** implicit device→host sync inside a loop: ``.item()``,
+  ``float()``/``int()``/``np.asarray()`` over jit/``jnp`` results, and
+  ``jax.device_get``/``block_until_ready`` — each blocks the host on the
+  device stream mid-loop. Designed sync points (a readback that IS the
+  product) carry ``lint: allow(JIT502)``.
+- **JIT503** use-after-donate: an argument in a ``donate_argnums``
+  position is read again after the call without being rebound from its
+  results — the donated buffer no longer exists.
+- **JIT504** shape-varying argument: a slice with non-constant bounds
+  passed straight into a jitted call inside a loop recompiles per shape;
+  pad to a bucket instead (``_pow2_buckets``).
+
+Jitted callables are recognised three ways: ``@jax.jit``-style
+decorators (incl. ``partial(jax.jit, ...)``), ``name = jax.jit(...)``
+assignments (incl. ``self._x = jax.jit(...)``), and — the repo
+convention — any callable whose name ends in ``_jit``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .engine import ERROR, LintContext, WARNING, rule
+from .pysource import (
+    ParsedModule,
+    call_name,
+    const_int,
+    each_module,
+    walk_functions,
+)
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.", "jax.numpy.", "jax.lax.")
+
+
+@dataclass
+class JitInfo:
+    """What the module statically knows about one jitted callable."""
+
+    name: str
+    static_idx: set = field(default_factory=set)
+    static_names: set = field(default_factory=set)
+    donate_idx: set = field(default_factory=set)
+    # False for convention-only (``*_jit``) names whose jit kwargs are
+    # not visible in this module
+    known: bool = True
+    method: bool = False  # statics/donations count ``self`` at index 0
+
+
+def _int_set(node: Optional[ast.AST]) -> set:
+    """Literal int / tuple-or-list-of-int kwarg value, else empty."""
+    if node is None:
+        return set()
+    v = const_int(node)
+    if v is not None:
+        return {v}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            v = const_int(elt)
+            if v is None:
+                return set()
+            out.add(v)
+        return out
+    return set()
+
+
+def _str_set(node: Optional[ast.AST]) -> set:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return set()
+            out.add(elt.value)
+        return out
+    return set()
+
+
+def _jit_call_kwargs(call: ast.Call) -> Optional[JitInfo]:
+    """Parse a ``jax.jit(...)``/``partial(jax.jit, ...)`` call's static/
+    donate kwargs. None when the call isn't a jit wrap."""
+    name = call_name(call)
+    if name in _JIT_NAMES:
+        inner = call
+    elif name in ("partial", "functools.partial") and call.args:
+        if call_name(call.args[0]) not in _JIT_NAMES:
+            return None
+        inner = call
+    else:
+        return None
+    info = JitInfo(name="")
+    for kw in inner.keywords:
+        if kw.arg == "static_argnums":
+            info.static_idx = _int_set(kw.value)
+        elif kw.arg == "static_argnames":
+            info.static_names = _str_set(kw.value)
+        elif kw.arg == "donate_argnums":
+            info.donate_idx = _int_set(kw.value)
+    return info
+
+
+def jit_registry(tree: ast.Module) -> dict:
+    """``{callable name: JitInfo}`` for everything the module jits.
+
+    Assignment targets keep only their terminal attribute name
+    (``self._carry_update_jit`` registers ``_carry_update_jit``) so call
+    sites resolve regardless of the receiver expression.
+    """
+    registry: dict[str, JitInfo] = {}
+
+    def register(target: ast.AST, info: JitInfo):
+        if isinstance(target, ast.Name):
+            info.name = target.id
+            registry[target.id] = info
+        elif isinstance(target, ast.Attribute):
+            info.name = target.attr
+            registry[target.attr] = info
+
+    class_stack: list[str] = []
+
+    def visit(node, in_class: bool):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                visit(sub, True)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                info = None
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_kwargs(dec)
+                elif call_name(dec) in _JIT_NAMES:
+                    info = JitInfo(name="")
+                if info is not None:
+                    info.name = node.name
+                    info.method = in_class
+                    registry[node.name] = info
+                    break
+            for sub in node.body:
+                visit(sub, False)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if isinstance(value, ast.Call):
+                info = _jit_call_kwargs(value)
+                if info is not None:
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        register(t, info)
+        for sub in ast.iter_child_nodes(node):
+            visit(sub, in_class)
+
+    for top in tree.body:
+        visit(top, False)
+    return registry
+
+
+def _resolve_jit(registry: dict, call: ast.Call) -> Optional[JitInfo]:
+    name = call_name(call)
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    info = registry.get(name) or registry.get(tail)
+    if info is not None:
+        return info
+    if tail.endswith("_jit"):
+        return JitInfo(name=tail, known=False)
+    return None
+
+
+def _is_device_expr(node: ast.AST, registry: dict, device_names: set) -> bool:
+    """Heuristic: does this expression live on device? Calls into
+    jnp/jax/lax or a known-jitted callable, or names previously assigned
+    from one."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name.startswith(_DEVICE_PREFIXES):
+            return True
+        return _resolve_jit(registry, node) is not None
+    dotted = call_name(node) if isinstance(node, (ast.Name, ast.Attribute)) else ""
+    return bool(dotted) and dotted in device_names
+
+
+def _device_assigned_names(fn: ast.AST, registry: dict) -> set:
+    """Names (dotted) bound in this function from device-producing
+    calls — one forward pass, no flow sensitivity (linter precision)."""
+    out: set = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_device_expr(node.value, registry, out):
+            continue
+        for t in node.targets:
+            if isinstance(t, (ast.Name, ast.Attribute)):
+                out.add(call_name(t))
+            elif isinstance(t, ast.Tuple):
+                for elt in t.elts:
+                    if isinstance(elt, (ast.Name, ast.Attribute)):
+                        out.add(call_name(elt))
+    return out
+
+
+def _store_names(stmt: ast.AST) -> set:
+    """Dotted names this statement (re)binds."""
+    out: set = set()
+    targets: list = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        stack = [t]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Tuple, ast.List)):
+                stack.extend(n.elts)
+            elif isinstance(n, (ast.Name, ast.Attribute)):
+                out.add(call_name(n))
+            elif isinstance(n, ast.Starred):
+                stack.append(n.value)
+    return out
+
+
+class _FnScan(ast.NodeVisitor):
+    """One pass over a function body tracking loop depth and the
+    enclosing statement, collecting JIT5xx findings."""
+
+    def __init__(self, mod: ParsedModule, qualname: str, fn, registry,
+                 findings: list):
+        self.mod = mod
+        self.qualname = qualname
+        self.fn = fn
+        self.registry = registry
+        self.findings = findings
+        self.loop_depth = 0
+        self.stmt: Optional[ast.AST] = None
+        self.device_names = _device_assigned_names(fn, registry)
+
+    def emit(self, rule_id, severity, message, node):
+        f = self.mod.finding(
+            rule_id, severity, "hotpath", message, node,
+            location=self.qualname,
+        )
+        if f is not None:
+            self.findings.append(f)
+
+    # -- structure ---------------------------------------------------------
+    def visit_body(self):
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own _FnScan via walk_functions
+        prev = self.stmt
+        self.stmt = stmt
+        loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+        if loop:
+            self.loop_depth += 1
+        for sub in ast.iter_child_nodes(stmt):
+            self._node(sub)
+        if loop:
+            self.loop_depth -= 1
+        self.stmt = prev
+
+    def _node(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.stmt):
+            self._stmt(node)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+        for sub in ast.iter_child_nodes(node):
+            self._node(sub)
+
+    # -- the checks --------------------------------------------------------
+    def _call(self, node: ast.Call):
+        name = call_name(node)
+        if name in _JIT_NAMES and self.loop_depth > 0:
+            self.emit(
+                "JIT500", ERROR,
+                "jax.jit called inside a loop — every iteration builds a "
+                "fresh jitted callable with its own compile-cache entry "
+                "(hoist the jit out of the loop)",
+                node,
+            )
+            return
+        info = _resolve_jit(self.registry, node)
+        if info is not None:
+            self._jitted_call(node, name, info)
+        self._host_sync(node, name)
+
+    def _jitted_call(self, node: ast.Call, name: str, info: JitInfo):
+        offset = 1 if info.method and "." in name else 0
+        if self.loop_depth > 0:
+            for idx in sorted(info.static_idx):
+                pos = idx - offset
+                if not 0 <= pos < len(node.args):
+                    continue
+                arg = node.args[pos]
+                if isinstance(arg, ast.Constant):
+                    continue
+                self.emit(
+                    "JIT501", ERROR,
+                    f"non-constant value in static_argnums position {idx} "
+                    f"of jitted {name}() inside a loop — XLA recompiles "
+                    "per distinct value (pass it traced, or bucket it)",
+                    arg,
+                )
+            for kw in node.keywords:
+                if (
+                    kw.arg in info.static_names
+                    and not isinstance(kw.value, ast.Constant)
+                ):
+                    self.emit(
+                        "JIT501", ERROR,
+                        f"non-constant value for static_argnames "
+                        f"{kw.arg!r} of jitted {name}() inside a loop — "
+                        "XLA recompiles per distinct value",
+                        kw.value,
+                    )
+            for arg in node.args:
+                if (
+                    isinstance(arg, ast.Subscript)
+                    and isinstance(arg.slice, ast.Slice)
+                    and any(
+                        b is not None and const_int(b) is None
+                        for b in (arg.slice.lower, arg.slice.upper)
+                    )
+                ):
+                    self.emit(
+                        "JIT504", WARNING,
+                        f"slice with non-constant bounds passed to jitted "
+                        f"{name}() inside a loop — the argument shape "
+                        "varies per iteration and recompiles (pad to a "
+                        "fixed bucket instead)",
+                        arg,
+                    )
+        if info.donate_idx and self.stmt is not None:
+            self._donation(node, name, info)
+
+    def _donation(self, node: ast.Call, name: str, info: JitInfo):
+        offset = 1 if info.method and "." in name else 0
+        rebound = _store_names(self.stmt)
+        for idx in sorted(info.donate_idx):
+            pos = idx - offset
+            if not 0 <= pos < len(node.args):
+                continue
+            arg = node.args[pos]
+            if not isinstance(arg, (ast.Name, ast.Attribute)):
+                continue
+            dotted = call_name(arg)
+            if not dotted or dotted in rebound:
+                continue
+            if self._read_after(dotted, node):
+                self.emit(
+                    "JIT503", ERROR,
+                    f"{dotted} is donated to {name}() (donate_argnums "
+                    f"position {idx}) but read again afterwards without "
+                    "being rebound from the results — the donated buffer "
+                    "is gone after the call",
+                    node,
+                )
+
+    def _read_after(self, dotted: str, call: ast.Call) -> bool:
+        """Is ``dotted`` loaded after the call line before any store?
+        Line-ordered approximation — branch-insensitive, like the rest
+        of the pack."""
+        call_line = getattr(call, "lineno", 0)
+        first_load = None
+        first_store = None
+        for node in ast.walk(self.fn):
+            line = getattr(node, "lineno", 0)
+            if line <= call_line:
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if call_name(node) != dotted:
+                    continue
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, ast.Store):
+                    if first_store is None or line < first_store:
+                        first_store = line
+                elif isinstance(ctx, ast.Load):
+                    if first_load is None or line < first_load:
+                        first_load = line
+        if first_load is None:
+            return False
+        return first_store is None or first_load <= first_store
+
+    def _host_sync(self, node: ast.Call, name: str):
+        if self.loop_depth == 0:
+            return
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        if tail == "item" and not node.args:
+            self.emit(
+                "JIT502", WARNING,
+                ".item() inside a loop blocks the host on the device "
+                "stream every iteration (read the whole array back once, "
+                "outside the loop)",
+                node,
+            )
+            return
+        if name in ("jax.device_get", "device_get") or tail == "block_until_ready":
+            self.emit(
+                "JIT502", WARNING,
+                f"{tail or name}() inside a loop is a device→host sync "
+                "point every iteration",
+                node,
+            )
+            return
+        if name in ("float", "int", "bool") and len(node.args) == 1:
+            if _is_device_expr(node.args[0], self.registry, self.device_names):
+                self.emit(
+                    "JIT502", WARNING,
+                    f"{name}() over a device value inside a loop forces a "
+                    "blocking device→host transfer every iteration",
+                    node,
+                )
+            return
+        if name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+            if node.args and _is_device_expr(
+                node.args[0], self.registry, self.device_names
+            ):
+                self.emit(
+                    "JIT502", WARNING,
+                    f"{name}() over a device value inside a loop forces a "
+                    "blocking device→host transfer every iteration",
+                    node,
+                )
+
+
+def _scan_module(mod: ParsedModule, findings: list):
+    registry = jit_registry(mod.tree)
+    for qualname, fn in walk_functions(mod.tree):
+        _FnScan(mod, qualname, fn, registry, findings).visit_body()
+
+
+def _run_pack(ctx: LintContext) -> list:
+    """All JIT5xx findings for the context, computed once and cached —
+    the per-rule entries below filter by id so each keeps its own
+    registry metadata without re-walking the ASTs."""
+    cache = getattr(ctx, "_hotpath_findings", None)
+    if cache is None:
+        raw: list = []
+        for mod in each_module(ctx):
+            _scan_module(mod, raw)
+        # two np.asarray() on one line are one finding, not two
+        seen: set = set()
+        cache = []
+        for f in raw:
+            key = f.sort_key()
+            if key not in seen:
+                seen.add(key)
+                cache.append(f)
+        ctx._hotpath_findings = cache
+    return cache
+
+
+def _only(ctx: LintContext, rule_id: str):
+    return [f for f in _run_pack(ctx) if f.rule_id == rule_id]
+
+
+@rule(
+    "JIT500",
+    severity=ERROR,
+    category="hotpath",
+    description="jax.jit must not be called inside a loop (fresh "
+    "compile-cache entry per iteration)",
+)
+def check_jit_in_loop(ctx: LintContext):
+    return _only(ctx, "JIT500")
+
+
+@rule(
+    "JIT501",
+    severity=ERROR,
+    category="hotpath",
+    description="static_argnums/static_argnames positions of jitted "
+    "calls in loops must be constant (recompile per distinct value)",
+)
+def check_varying_static_arg(ctx: LintContext):
+    return _only(ctx, "JIT501")
+
+
+@rule(
+    "JIT502",
+    severity=WARNING,
+    category="hotpath",
+    description="no implicit device→host sync (.item()/float()/"
+    "np.asarray/device_get) inside hot loops",
+)
+def check_host_sync_in_loop(ctx: LintContext):
+    return _only(ctx, "JIT502")
+
+
+@rule(
+    "JIT503",
+    severity=ERROR,
+    category="hotpath",
+    description="a donated argument must not be read after the jitted "
+    "call unless rebound from its results",
+)
+def check_use_after_donate(ctx: LintContext):
+    return _only(ctx, "JIT503")
+
+
+@rule(
+    "JIT504",
+    severity=WARNING,
+    category="hotpath",
+    description="arguments to jitted calls in loops must not be "
+    "non-constant slices (shape-varying → recompile per shape)",
+)
+def check_shape_varying_arg(ctx: LintContext):
+    return _only(ctx, "JIT504")

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""CI gate for the hot-path & concurrency analyzers: static + runtime.
+
+Four legs, all of which must pass for exit 0:
+
+1. **Self-lint** — run the AST packs (PY5xx/JIT5xx/CON6xx) over
+   ``devspace_tpu/``, ``scripts/`` and ``bench.py``. Any finding not in
+   ``scripts/analysis_baseline.json`` fails the gate (warnings too —
+   the ratchet only moves one way; intentional sync points carry
+   ``lint: allow(...)`` pragmas instead of baseline entries). SARIF
+   goes to ``--output`` for code-scanning upload.
+2. **Catalog lint** — the OBS7xx family over every live metric/event/
+   timeline catalog (what scripts/metrics_lint.py fronts).
+3. **Fixture detection** — every seeded bug under
+   ``tests/fixtures/analysis/`` declares the rule ids it must trip in a
+   ``# expect:`` header; a missed one is a false negative in the
+   analyzer and fails the gate.
+4. **CompileWatch serving tripwire** — a TINY CPU engine runs a warmup
+   wave, then an identical second wave under CompileWatch: any XLA
+   compile after warmup is a hot-path recompile (the PR 7 class) and
+   fails the gate. ``--skip-serving`` skips this leg (seconds vs
+   sub-second), e.g. for doc-only pushes.
+
+Usage: python scripts/analysis_gate.py [--output gate.sarif]
+       [--skip-serving] [--text]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9 ,]+)")
+
+BASELINE_PATH = os.path.join(REPO, "scripts", "analysis_baseline.json")
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "analysis")
+# what the self-lint leg covers (package + the tooling that ships it)
+SOURCE_ROOTS = ("devspace_tpu", "scripts")
+EXTRA_SOURCES = ("bench.py",)
+
+
+def _load_baseline() -> set:
+    """Finding keys (``RULEID artifact:line``) accepted as known debt.
+    Absent file == empty baseline: the normal state is zero."""
+    if not os.path.exists(BASELINE_PATH):
+        return set()
+    with open(BASELINE_PATH, encoding="utf-8") as fh:
+        return set(json.load(fh))
+
+
+def _finding_key(f) -> str:
+    return f"{f.rule_id} {f.artifact}:{f.line}"
+
+
+def self_lint(output: str, text: bool) -> list[str]:
+    from devspace_tpu.lint import collect_python_sources, lint_python_sources
+    from devspace_tpu.lint.reporters import to_sarif_json, to_text
+
+    sources = collect_python_sources(REPO, SOURCE_ROOTS)
+    for rel in EXTRA_SOURCES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                sources.append((rel, fh.read()))
+    sources.sort()
+    findings = lint_python_sources(sources)
+    if output:
+        with open(output, "w", encoding="utf-8") as fh:
+            fh.write(to_sarif_json(findings) + "\n")
+    if text and findings:
+        print(to_text(findings))
+    baseline = _load_baseline()
+    problems = []
+    for f in findings:
+        key = _finding_key(f)
+        if key not in baseline:
+            loc = f" [{f.location}]" if f.location else ""
+            problems.append(
+                f"self-lint: {key}{loc}: {f.message}"
+            )
+    print(
+        f"[gate] self-lint: {len(sources)} files, {len(findings)} "
+        f"finding(s), {len(problems)} above baseline"
+    )
+    return problems
+
+
+def catalog_lint() -> list[str]:
+    from devspace_tpu.lint import lint_obs_catalogs
+
+    findings = lint_obs_catalogs()
+    print(f"[gate] catalogs: {len(findings)} finding(s)")
+    return [
+        f"catalogs: {f.rule_id} {f.location}: {f.message}" for f in findings
+    ]
+
+
+def fixture_detection() -> list[str]:
+    """No false negatives: every seeded fixture must trip every rule id
+    its ``# expect:`` header declares."""
+    from devspace_tpu.lint import lint_python_sources
+
+    problems: list[str] = []
+    names = sorted(
+        n for n in os.listdir(FIXTURE_DIR) if n.endswith(".py")
+    )
+    if not names:
+        return ["fixtures: none found under tests/fixtures/analysis/"]
+    checked = 0
+    for name in names:
+        path = os.path.join(FIXTURE_DIR, name)
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        m = _EXPECT_RE.search(text)
+        if not m:
+            problems.append(f"fixtures: {name} has no '# expect:' header")
+            continue
+        expected = {
+            p.strip() for p in m.group(1).replace(",", " ").split() if p.strip()
+        }
+        rel = os.path.join("tests", "fixtures", "analysis", name)
+        found = {f.rule_id for f in lint_python_sources([(rel, text)])}
+        missing = sorted(expected - found)
+        if missing:
+            problems.append(
+                f"fixtures: {name} expected {sorted(expected)} but "
+                f"{missing} did not fire (found {sorted(found) or 'none'})"
+            )
+        checked += len(expected)
+    print(
+        f"[gate] fixtures: {len(names)} seeded bug(s), {checked} expected "
+        f"detection(s), {len(problems)} missed"
+    )
+    return problems
+
+
+def serving_tripwire() -> list[str]:
+    """Warm a TINY CPU engine, then rerun the identical wave under
+    CompileWatch — the dynamic half of JIT5xx."""
+    import numpy as np
+
+    from devspace_tpu.inference import InferenceEngine
+    from devspace_tpu.lint.runtime import CompileWatch
+    from devspace_tpu.models import transformer as tfm
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cfg = tfm.TINY
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(1, 1000, size=int(rng.integers(4, 24))))
+        for _ in range(2)
+    ]
+    engine = InferenceEngine(
+        params, cfg, max_slots=2, max_len=64, chunk_max=4
+    ).start()
+    try:
+        with CompileWatch("gate-serving") as watch:
+            for h in [engine.submit(p, 8) for p in prompts]:
+                h.result(timeout=300)
+            watch.reset()  # warmup compiles are expected
+            for h in [engine.submit(p, 8) for p in prompts]:
+                h.result(timeout=300)
+    finally:
+        engine.stop()
+    print(
+        f"[gate] serving tripwire: {watch.count} recompile(s) after warmup"
+    )
+    if watch.count:
+        return [
+            f"serving: {watch.count} XLA compilation(s) after warmup — "
+            "a hot-path recompile (varying static arg, shape drift, or a "
+            "fresh jit per call)"
+        ]
+    return []
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--output", help="write the self-lint SARIF here")
+    ap.add_argument(
+        "--text", action="store_true",
+        help="also print self-lint findings as text",
+    )
+    ap.add_argument(
+        "--skip-serving", action="store_true",
+        help="skip the CompileWatch serving leg (static checks only)",
+    )
+    args = ap.parse_args()
+
+    problems = self_lint(args.output, args.text)
+    problems += catalog_lint()
+    problems += fixture_detection()
+    if not args.skip_serving:
+        problems += serving_tripwire()
+    else:
+        print("[gate] serving tripwire: skipped (--skip-serving)")
+
+    for p in problems:
+        print(f"ERROR {p}")
+    if problems:
+        print(f"[gate] FAIL: {len(problems)} problem(s)")
+        return 1
+    print("[gate] ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,11 @@ REQUIRED_CHAOS_MODULES = (
     # the rest of the fleet still renders; garbage exposition must be
     # counted and quarantined, never raise out of the collector
     "test_obs_fleet",
+    # runtime lock-order tripwires (ISSUE 17): an event-sequenced
+    # opposite-order schedule must surface exactly one inversion, and a
+    # runtime order contradicting the static lock graph must be flagged
+    # even though no thread ever saw both orders
+    "test_lint_runtime",
 )
 
 

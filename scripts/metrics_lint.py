@@ -1,203 +1,41 @@
 #!/usr/bin/env python
-"""Metrics-catalog lint: naming conventions + registrability.
+"""Metrics-catalog lint — thin shim over the OBS7xx rule family.
 
-Walks every ``*_METRIC_FAMILIES`` catalog the subsystems export (engine,
-serving telemetry, sync, resilience, trace) and enforces the conventions
-docs/observability.md documents, so a metric can't ship with a name
-Prometheus tooling chokes on or operators can't grep:
+The checks this script accumulated (snake_case names, counter/histogram
+suffixes, fleet aggregation hints, cross-catalog duplicates,
+registrability, timeline-track and event-catalog validity) now live in
+the rule engine as OBS700–OBS708 (``devspace_tpu/lint/rules_obs.py``),
+where they get stable ids, SARIF output, and ``--select``/``--ignore``
+filtering. This entry point keeps its contract: ``ERROR ...`` lines per
+problem, exit 1 on any, and an ``ok:`` summary on success.
 
-- names are snake_case (``[a-z][a-z0-9_]*``)
-- counters end in ``_total``; nothing else may
-- histograms and time/size gauges carry a unit suffix (``_seconds``,
-  ``_bytes``, or an explicit whitelist for unit-less gauges)
-- help strings are nonempty and don't repeat the metric name verbatim
-- every family declares a fleet aggregation hint as its LAST element
-  (``sum``/``max``/``avg``/``last`` — obs/fleet.py federation); counters
-  and histograms must declare ``sum`` (they merge exactly)
-- no duplicate names across catalogs (the /metrics endpoint concatenates
-  the engine registry with the process-wide one — prefixes must stay
-  disjoint)
-- every family actually registers into a fresh Registry (kind is valid,
-  name passes the registry's own validation)
-
-Exits non-zero on any violation. Usage: python scripts/metrics_lint.py
+Usage: python scripts/metrics_lint.py
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # engine import pulls in jax
 
-_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-_UNIT_SUFFIXES = ("_seconds", "_bytes")
-# Gauges that are plain quantities (slots, blocks, depths, ratios) —
-# names where a unit suffix would be noise, not information.
-_UNITLESS_GAUGE_SUFFIXES = (
-    "_slots",
-    "_blocks",
-    "_requests",
-    "_depth",
-    "_occupancy",
-    "_status",
-    "_ratio",
-)
-_RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
-# collector fleet gauges: target counts and health bits
-_UNITLESS_GAUGE_SUFFIXES += ("_targets", "_targets_up", "_up", "_quarantined")
-
-
-def load_catalogs() -> dict[str, tuple]:
-    """{catalog label: ((name, kind, help, *rest), ...)} — import order
-    matters only for jax (engine); everything else is dependency-free."""
-    from devspace_tpu.inference.engine import ENGINE_METRIC_FAMILIES
-    from devspace_tpu.obs.collector import COLLECTOR_METRIC_FAMILIES
-    from devspace_tpu.obs.events import EVENTS_METRIC_FAMILIES
-    from devspace_tpu.obs.request_trace import SERVING_METRIC_FAMILIES
-    from devspace_tpu.obs.slo import SLO_METRIC_FAMILIES
-    from devspace_tpu.obs.tracing import TRACING_METRIC_FAMILIES
-    from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
-    from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
-    from devspace_tpu.utils.trace import TRACE_METRIC_FAMILIES
-
-    return {
-        "engine": ENGINE_METRIC_FAMILIES,
-        "serving": SERVING_METRIC_FAMILIES,
-        "sync": SYNC_METRIC_FAMILIES,
-        "resilience": RESILIENCE_METRIC_FAMILIES,
-        "trace": TRACE_METRIC_FAMILIES,
-        "tracing": TRACING_METRIC_FAMILIES,
-        "events": EVENTS_METRIC_FAMILIES,
-        "slo": SLO_METRIC_FAMILIES,
-        "collector": COLLECTOR_METRIC_FAMILIES,
-    }
-
-
-def lint(catalogs: dict[str, tuple]) -> list[str]:
-    problems: list[str] = []
-    seen: dict[str, str] = {}
-    for label, families in catalogs.items():
-        for fam in families:
-            name, kind, help_ = fam[0], fam[1], fam[2]
-            where = f"{label}:{name}"
-            if not _NAME_RE.match(name):
-                problems.append(f"{where}: not snake_case")
-            if kind not in ("counter", "gauge", "histogram"):
-                problems.append(f"{where}: unknown kind {kind!r}")
-            if kind == "counter" and not name.endswith("_total"):
-                problems.append(f"{where}: counters must end in _total")
-            if kind != "counter" and name.endswith("_total"):
-                problems.append(f"{where}: _total is reserved for counters")
-            if kind == "histogram" and not name.endswith(_UNIT_SUFFIXES):
-                problems.append(
-                    f"{where}: histograms need a unit suffix "
-                    f"({'/'.join(_UNIT_SUFFIXES)})"
-                )
-            if kind == "gauge" and not (
-                name.endswith(_UNIT_SUFFIXES)
-                or name.endswith(_UNITLESS_GAUGE_SUFFIXES)
-                or _RATE_RE.search(name)
-            ):
-                problems.append(
-                    f"{where}: gauge needs a unit suffix or a whitelisted "
-                    "quantity suffix (see scripts/metrics_lint.py)"
-                )
-            if not help_ or not help_.strip():
-                problems.append(f"{where}: empty help string")
-            elif help_.strip() == name:
-                problems.append(f"{where}: help string just repeats the name")
-            # fleet aggregation hint (ISSUE 10): the federation layer
-            # (obs/fleet.py) refuses to guess how a family merges — the
-            # catalog must say. Counters and histograms merge exactly,
-            # so anything but "sum" on them is a contradiction.
-            from devspace_tpu.obs.fleet import FLEET_AGG_KINDS
-
-            hint = fam[-1]
-            if hint not in FLEET_AGG_KINDS:
-                problems.append(
-                    f"{where}: missing/invalid aggregation hint {hint!r} as "
-                    f"the last tuple element (want one of {FLEET_AGG_KINDS})"
-                )
-            elif kind in ("counter", "histogram") and hint != "sum":
-                problems.append(
-                    f"{where}: {kind}s merge exactly across the fleet — "
-                    f"the hint must be \"sum\", not {hint!r}"
-                )
-            if name in seen:
-                problems.append(
-                    f"{where}: duplicate of {seen[name]} (the /metrics "
-                    "endpoint concatenates registries — names must be unique)"
-                )
-            seen[name] = where
-    return problems
-
-
-def check_registrable(catalogs: dict[str, tuple]) -> list[str]:
-    """Register every family into a fresh Registry — catches anything the
-    name regex above is looser about than the registry itself."""
-    from devspace_tpu.obs.metrics import Registry
-
-    problems = []
-    reg = Registry()
-    for label, families in catalogs.items():
-        for fam in families:
-            name, kind, help_ = fam[0], fam[1], fam[2]
-            try:
-                if kind == "counter":
-                    reg.counter(name, help_)
-                elif kind == "gauge":
-                    reg.gauge(name, help_)
-                elif kind == "histogram":
-                    reg.histogram(name, help_)
-            except Exception as e:  # noqa: BLE001 — report, don't crash
-                problems.append(f"{label}:{name}: registry rejected it: {e}")
-    try:
-        reg.render()
-    except Exception as e:  # noqa: BLE001
-        problems.append(f"render() over all catalogs failed: {e}")
-    return problems
-
-
-def check_timeline_tracks() -> list[str]:
-    """Timeline-lane catalog lint (obs/tracing.py): every Chrome-export
-    track name must be nonempty and unique, or the profiler UI silently
-    merges/anonymizes lanes."""
-    from devspace_tpu.obs import tracing
-
-    return tracing.lint_tracks()
-
-
-def check_event_catalog() -> tuple[list[str], int]:
-    """Structured-event catalog lint (obs/events.py): names snake_case,
-    subsystems known, (subsystem, name) pairs unique, help nonempty — so
-    a misspelled event can't ship and dashboards grep one stable set."""
-    from devspace_tpu.obs import events
-
-    return (
-        [f"events:{p}" for p in events.lint_catalog()],
-        len(events.EVENT_CATALOG),
-    )
-
 
 def main() -> int:
-    catalogs = load_catalogs()
-    event_problems, n_events = check_event_catalog()
-    problems = (
-        lint(catalogs)
-        + check_registrable(catalogs)
-        + check_timeline_tracks()
-        + event_problems
-    )
+    from devspace_tpu.lint import lint_obs_catalogs, load_metric_catalogs
+    from devspace_tpu.obs import events
+
+    catalogs = load_metric_catalogs()
+    findings = lint_obs_catalogs(catalogs)
     n = sum(len(f) for f in catalogs.values())
-    for p in problems:
-        print(f"ERROR {p}")
-    if problems:
+    n_events = len(events.EVENT_CATALOG)
+    for f in findings:
+        where = f.location or f.rule_id
+        print(f"ERROR {where}: {f.message} [{f.rule_id}]")
+    if findings:
         print(
-            f"{len(problems)} problem(s) across {n} metric families "
+            f"{len(findings)} problem(s) across {n} metric families "
             f"and {n_events} event names"
         )
         return 1

@@ -1,0 +1,13 @@
+# expect: CON604
+# acquire() with the matching release() outside any finally: an
+# exception in between leaks the lock forever.
+import threading
+
+_lock = threading.Lock()
+_state = {}
+
+
+def update(key, value):
+    _lock.acquire()
+    _state[key] = value  # a KeyError/MemoryError here leaks _lock
+    _lock.release()

@@ -1,0 +1,18 @@
+# expect: CON601
+# The RateLimiter.throttle bug class: sleeping while holding the lock
+# stalls every other thread contending for it.
+import threading
+import time
+
+
+class Limiter:
+    def __init__(self, rate):
+        self._lock = threading.Lock()
+        self.rate = rate
+        self.allowance = rate
+
+    def throttle(self):
+        with self._lock:
+            if self.allowance < 1:
+                time.sleep(1.0 / self.rate)
+            self.allowance -= 1

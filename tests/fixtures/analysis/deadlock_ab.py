@@ -1,0 +1,23 @@
+# expect: CON600
+# Two call paths taking the same two locks in opposite orders: two
+# threads (one per path) wedge forever.
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._alloc_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.free = []
+        self.stats = {}
+
+    def take(self):
+        with self._alloc_lock:
+            with self._stats_lock:
+                self.stats["takes"] = self.stats.get("takes", 0) + 1
+                return self.free.pop()
+
+    def report(self):
+        with self._stats_lock:
+            with self._alloc_lock:
+                return dict(self.stats, free=len(self.free))

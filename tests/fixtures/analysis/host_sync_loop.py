@@ -1,0 +1,15 @@
+# expect: JIT502
+# Implicit device->host syncs inside the hot loop: .item() and
+# np.asarray over a jnp result both block the host per iteration.
+import jax.numpy as jnp
+import numpy as np
+
+
+def accumulate(logits_seq):
+    total = 0.0
+    rows = []
+    for logits in logits_seq:
+        probs = jnp.exp(logits)
+        total += probs.max().item()
+        rows.append(np.asarray(probs))
+    return total, rows

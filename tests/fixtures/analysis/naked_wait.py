@@ -1,0 +1,17 @@
+# expect: CON602
+# Condition.wait() guarded by a bare if: spurious wakeups and stolen
+# notifications act on stale state -- the predicate must re-check in a
+# while loop.
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cond:
+            if not self.items:
+                self._cond.wait(1.0)
+            return self.items.pop(0)

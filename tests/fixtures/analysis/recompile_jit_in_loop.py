@@ -1,0 +1,11 @@
+# expect: JIT500
+# A fresh jax.jit per iteration: nothing ever hits the compile cache.
+import jax
+
+
+def sweep(xs, scale):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * scale)
+        out.append(f(x))
+    return out

@@ -1,0 +1,13 @@
+# expect: JIT501
+# The PR 7 bug class, distilled: a Python int in a static_argnums
+# position varies per loop iteration -> one XLA compile per block id.
+import jax
+
+decode_jit = jax.jit(lambda pool, idx: pool[idx], static_argnums=(1,))
+
+
+def drain(pool, block_ids):
+    out = []
+    for bid in block_ids:
+        out.append(decode_jit(pool, bid))  # recompiles per distinct bid
+    return out

@@ -1,0 +1,13 @@
+# expect: JIT504
+# A slice with non-constant bounds straight into a jitted call inside a
+# loop: the argument shape varies per iteration and recompiles per shape.
+import jax
+
+score_jit = jax.jit(lambda toks: toks * 2)
+
+
+def score_prefixes(toks, lengths):
+    outs = []
+    for n in lengths:
+        outs.append(score_jit(toks[:n]))
+    return outs

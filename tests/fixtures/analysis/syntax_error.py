@@ -1,0 +1,5 @@
+# expect: PY500
+# A module that does not parse is itself a finding -- nothing else can
+# be checked until it does.
+def broken(:
+    return 1

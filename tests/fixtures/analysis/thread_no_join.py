@@ -1,0 +1,10 @@
+# expect: CON603
+# A non-daemon thread with no join() anywhere in the module: the
+# process cannot exit while it runs.
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

@@ -1,0 +1,14 @@
+# expect: JIT503
+# The donated buffer is read after the call without being rebound from
+# the results -- it no longer exists on device.
+import jax
+
+step_jit = jax.jit(lambda carry, x: (carry + x, carry), donate_argnums=(0,))
+
+
+def run(carry, xs):
+    outs = []
+    for x in xs:
+        new_carry, out = step_jit(carry, x)
+        outs.append(out)
+    return carry.sum(), outs  # carry was donated above

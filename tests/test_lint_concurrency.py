@@ -1,0 +1,412 @@
+"""CON6xx concurrency rule pack: static lock graph, cycle detection
+(including randomized graphs against a topological-sort oracle),
+blocking-while-held, condition-wait hygiene, thread lifecycle — and the
+golden SARIF for the seeded deadlock fixture."""
+
+import json
+import os
+import random
+
+from devspace_tpu.lint import extract_lock_graph, lint_python_sources
+from devspace_tpu.lint.reporters import to_sarif
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def run(src: str, path: str = "mod.py"):
+    return lint_python_sources([(path, src)])
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- CON600: lock-order cycles ---------------------------------------------
+
+AB_SRC = (
+    "import threading\n"
+    "class P:\n"
+    "    def __init__(self):\n"
+    "        self._a = threading.Lock()\n"
+    "        self._b = threading.Lock()\n"
+    "    def one(self):\n"
+    "        with self._a:\n"
+    "            with self._b:\n"
+    "                pass\n"
+    "    def two(self):\n"
+    "        with self._b:\n"
+    "            with self._a:\n"
+    "                pass\n"
+)
+
+
+def test_opposite_orders_cycle():
+    fs = run(AB_SRC)
+    assert "CON600" in ids(fs)
+    (f,) = [f for f in fs if f.rule_id == "CON600"]
+    assert "_a" in f.message and "_b" in f.message
+
+
+def test_consistent_order_clean():
+    fs = run(
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )
+    assert "CON600" not in ids(fs)
+
+
+def test_interprocedural_cycle_through_method_call():
+    # one() holds _a and calls helper() which takes _b; two() nests the
+    # opposite way — the cycle spans a call edge
+    fs = run(
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def helper(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            self.helper()\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    assert "CON600" in ids(fs)
+
+
+def test_transitive_acquires_cross_two_calls():
+    g = extract_lock_graph(
+        "m.py",
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def inner(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def mid(self):\n"
+        "        self.inner()\n"
+        "    def outer(self):\n"
+        "        with self._a:\n"
+        "            self.mid()\n",
+    )
+    assert ("_a", "_b") in g.edges
+
+
+# -- randomized cycle detection vs a Kahn oracle ---------------------------
+
+def _random_lock_module(rng: random.Random, n_locks: int, n_edges: int):
+    """Synthesize a module whose with-nesting realizes a random edge
+    set; returns (source, edge set)."""
+    names = [f"lk{i}" for i in range(n_locks)]
+    lines = ["import threading"]
+    for n in names:
+        lines.append(f"{n} = threading.Lock()")
+    edges = set()
+    while len(edges) < n_edges:
+        a, b = rng.sample(names, 2)
+        edges.add((a, b))
+    for i, (a, b) in enumerate(sorted(edges)):
+        lines += [
+            f"def fn{i}():",
+            f"    with {a}:",
+            f"        with {b}:",
+            "            pass",
+        ]
+    return "\n".join(lines) + "\n", edges
+
+
+def _has_cycle(nodes, edges) -> bool:
+    indeg = {n: 0 for n in nodes}
+    for _, b in edges:
+        indeg[b] += 1
+    queue = [n for n in nodes if indeg[n] == 0]
+    seen = 0
+    while queue:
+        n = queue.pop()
+        seen += 1
+        for a, b in edges:
+            if a == n:
+                indeg[b] -= 1
+                if indeg[b] == 0:
+                    queue.append(b)
+    return seen < len(nodes)
+
+
+def test_randomized_cycles_match_oracle():
+    rng = random.Random(1234)
+    for trial in range(60):
+        n_locks = rng.randint(2, 6)
+        n_edges = rng.randint(1, min(8, n_locks * (n_locks - 1)))
+        src, edges = _random_lock_module(rng, n_locks, n_edges)
+        g = extract_lock_graph(f"rand{trial}.py", src)
+        assert set(g.edges) == edges
+        nodes = {f"lk{i}" for i in range(n_locks)}
+        assert bool(g.cycles()) == _has_cycle(nodes, edges), (
+            f"trial {trial}: cycles()={g.cycles()} edges={sorted(edges)}"
+        )
+
+
+def test_cycle_canonicalization_dedupes_rotations():
+    g = extract_lock_graph(
+        "m.py",
+        "import threading\n"
+        "a = threading.Lock()\n"
+        "b = threading.Lock()\n"
+        "c = threading.Lock()\n"
+        "def f1():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n"
+        "def f2():\n"
+        "    with b:\n"
+        "        with c:\n"
+        "            pass\n"
+        "def f3():\n"
+        "    with c:\n"
+        "        with a:\n"
+        "            pass\n",
+    )
+    assert g.cycles() == [("a", "b", "c")]
+
+
+# -- CON601: blocking while holding a lock ---------------------------------
+
+def test_sleep_under_lock_flagged():
+    fs = run(
+        "import threading, time\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def throttle(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"
+    )
+    assert "CON601" in ids(fs)
+
+
+def test_queue_get_under_lock_flagged():
+    fs = run(
+        "import threading\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.q = None\n"
+        "    def pull(self):\n"
+        "        with self._lock:\n"
+        "            return self.q.get()\n"
+    )
+    assert "CON601" in ids(fs)
+
+
+def test_dict_get_with_args_clean():
+    # .get with positional args is dict.get, not queue.get
+    fs = run(
+        "import threading\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.d = {}\n"
+        "    def read(self, k):\n"
+        "        with self._lock:\n"
+        "            return self.d.get(k, None)\n"
+    )
+    assert "CON601" not in ids(fs)
+
+
+def test_blocking_callee_propagates_one_level():
+    fs = run(
+        "import threading, time\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _settle(self):\n"
+        "        time.sleep(0.5)\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            self._settle()\n"
+    )
+    assert "CON601" in ids(fs)
+
+
+def test_sleep_outside_lock_clean():
+    fs = run(
+        "import threading, time\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def throttle(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "        time.sleep(0.1)\n"
+    )
+    assert "CON601" not in ids(fs)
+
+
+# -- CON602: condition waits -----------------------------------------------
+
+def test_wait_under_if_flagged():
+    fs = run(
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self.items = []\n"
+        "    def take(self):\n"
+        "        with self._cond:\n"
+        "            if not self.items:\n"
+        "                self._cond.wait(1.0)\n"
+        "            return self.items.pop()\n"
+    )
+    assert "CON602" in ids(fs)
+
+
+def test_wait_in_while_clean():
+    fs = run(
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self.items = []\n"
+        "    def take(self):\n"
+        "        with self._cond:\n"
+        "            while not self.items:\n"
+        "                self._cond.wait(1.0)\n"
+        "            return self.items.pop()\n"
+    )
+    assert "CON602" not in ids(fs)
+
+
+def test_dataclass_field_condition_discovered():
+    # the dataclass idiom: field(default_factory=threading.Condition)
+    fs = run(
+        "import threading\n"
+        "from dataclasses import dataclass, field\n"
+        "@dataclass\n"
+        "class R:\n"
+        "    _cond: threading.Condition = field(\n"
+        "        default_factory=threading.Condition\n"
+        "    )\n"
+        "    def wake(self):\n"
+        "        with self._cond:\n"
+        "            if True:\n"
+        "                self._cond.wait()\n"
+    )
+    assert "CON602" in ids(fs)
+
+
+# -- CON603 / CON604 -------------------------------------------------------
+
+def test_nondaemon_thread_without_join_flagged():
+    fs = run(
+        "import threading\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+    )
+    assert "CON603" in ids(fs)
+
+
+def test_daemon_thread_clean():
+    fs = run(
+        "import threading\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn, daemon=True)\n"
+        "    t.start()\n"
+    )
+    assert "CON603" not in ids(fs)
+
+
+def test_nondaemon_with_join_clean():
+    fs = run(
+        "import threading\n"
+        "def go(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+    )
+    assert "CON603" not in ids(fs)
+
+
+def test_bare_acquire_flagged_and_finally_clean():
+    flagged = run(
+        "import threading\n"
+        "lk = threading.Lock()\n"
+        "def f():\n"
+        "    lk.acquire()\n"
+        "    lk.release()\n"
+    )
+    assert "CON604" in ids(flagged)
+    clean = run(
+        "import threading\n"
+        "lk = threading.Lock()\n"
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        lk.acquire()\n"
+        "        lk.release()\n"
+    )
+    assert "CON604" not in ids(clean)
+
+
+def test_nonblocking_acquire_clean():
+    fs = run(
+        "import threading\n"
+        "lk = threading.Lock()\n"
+        "def f():\n"
+        "    if lk.acquire(blocking=False):\n"
+        "        lk.release()\n"
+    )
+    assert "CON604" not in ids(fs)
+
+
+# -- pragma + golden SARIF -------------------------------------------------
+
+def test_allow_pragma_suppresses_con601():
+    fs = run(
+        "import threading, time\n"
+        "class L:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def throttle(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)  # lint: allow(CON601)\n"
+    )
+    assert "CON601" not in ids(fs)
+
+
+def _normalized_sarif(findings):
+    doc = to_sarif(findings)
+    for r in doc["runs"]:
+        r["tool"]["driver"]["version"] = "0"
+    return doc
+
+
+def test_golden_sarif_deadlock_fixture():
+    rel = "tests/fixtures/analysis/deadlock_ab.py"
+    with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+        findings = lint_python_sources([(rel, fh.read())])
+    with open(
+        os.path.join(FIXTURES, "golden_concurrency.sarif.json"),
+        encoding="utf-8",
+    ) as fh:
+        golden = json.load(fh)
+    assert _normalized_sarif(findings) == golden

@@ -40,15 +40,25 @@ def project(tmp_path, monkeypatch):
 def test_registry_rules_well_formed():
     from devspace_tpu.lint import REGISTRY, SEVERITIES
 
+    packs = {
+        "manifest",
+        "tpu",
+        "hygiene",
+        "sharding",
+        "image",
+        "hotpath",
+        "concurrency",
+        "obs",
+    }
     assert len(REGISTRY) >= 15  # manifest + tpu + sharding + image packs
     for rule_id, r in REGISTRY.items():
         assert r.id == rule_id
         assert r.severity in SEVERITIES
-        assert r.category in {"manifest", "tpu", "hygiene", "sharding", "image"}
+        assert r.category in packs
         assert r.description
     # every pack is represented
     cats = {r.category for r in REGISTRY.values()}
-    assert {"manifest", "tpu", "hygiene", "sharding", "image"} <= cats
+    assert packs <= cats
 
 
 def test_duplicate_rule_id_rejected():

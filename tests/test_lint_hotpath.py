@@ -1,0 +1,314 @@
+"""JIT5xx hot-path rule pack: recompile hazards, host syncs, donation
+misuse — plus rule filtering (--select/--ignore) and the golden SARIF
+for the seeded recompile fixture."""
+
+import json
+import os
+
+from devspace_tpu.lint import (
+    filter_findings,
+    lint_python_sources,
+    parse_rule_filter,
+    rule_selected,
+)
+from devspace_tpu.lint.reporters import to_sarif, to_sarif_json
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+
+def run(src: str, path: str = "mod.py"):
+    return lint_python_sources([(path, src)])
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# -- JIT500: jit inside a loop --------------------------------------------
+
+def test_jit_in_loop_flagged():
+    fs = run(
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        g = jax.jit(lambda v: v)\n"
+        "        g(x)\n"
+    )
+    assert "JIT500" in ids(fs)
+    (f,) = [f for f in fs if f.rule_id == "JIT500"]
+    assert f.line == 4
+    assert f.location == "f"
+
+
+def test_jit_outside_loop_clean():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda v: v)\n"
+        "def f(xs):\n"
+        "    return [g(x) for x in xs]\n"
+    )
+    assert "JIT500" not in ids(fs)
+
+
+# -- JIT501: varying static arg -------------------------------------------
+
+def test_varying_static_argnums_flagged():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda pool, i: pool[i], static_argnums=(1,))\n"
+        "def f(pool, idxs):\n"
+        "    for i in idxs:\n"
+        "        g(pool, i)\n"
+    )
+    assert "JIT501" in ids(fs)
+
+
+def test_constant_static_argnums_clean():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda pool, i: pool[i], static_argnums=(1,))\n"
+        "def f(pool, idxs):\n"
+        "    for _ in idxs:\n"
+        "        g(pool, 3)\n"
+    )
+    assert "JIT501" not in ids(fs)
+
+
+def test_varying_static_argnames_flagged():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda x, n=1: x * n, static_argnames=('n',))\n"
+        "def f(xs):\n"
+        "    for i, x in enumerate(xs):\n"
+        "        g(x, n=i)\n"
+    )
+    assert "JIT501" in ids(fs)
+
+
+def test_method_static_offset_accounts_for_self():
+    # static_argnums counts self at 0 on decorated methods: position 1
+    # is the FIRST call-site argument
+    fs = run(
+        "import jax\n"
+        "from functools import partial\n"
+        "class M:\n"
+        "    @partial(jax.jit, static_argnums=(1,))\n"
+        "    def step(self, n):\n"
+        "        return n\n"
+        "    def loop(self, ns):\n"
+        "        for n in ns:\n"
+        "            self.step(n)\n"
+    )
+    assert "JIT501" in ids(fs)
+
+
+# -- JIT502: host sync in loop --------------------------------------------
+
+def test_item_in_loop_flagged():
+    fs = run(
+        "def f(xs):\n"
+        "    t = 0\n"
+        "    for x in xs:\n"
+        "        t += x.item()\n"
+        "    return t\n"
+    )
+    assert "JIT502" in ids(fs)
+
+
+def test_asarray_over_device_value_flagged():
+    fs = run(
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        y = jnp.exp(x)\n"
+        "        out.append(np.asarray(y))\n"
+        "    return out\n"
+    )
+    assert "JIT502" in ids(fs)
+
+
+def test_asarray_over_host_value_clean():
+    fs = run(
+        "import numpy as np\n"
+        "def f(rows):\n"
+        "    out = []\n"
+        "    for r in rows:\n"
+        "        out.append(np.asarray(r))\n"
+        "    return out\n"
+    )
+    assert "JIT502" not in ids(fs)
+
+
+def test_sync_outside_loop_clean():
+    fs = run(
+        "import jax\n"
+        "def f(x):\n"
+        "    y = jax.device_get(x)\n"
+        "    return y\n"
+    )
+    assert "JIT502" not in ids(fs)
+
+
+def test_two_syncs_one_line_dedupe():
+    fs = run(
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        a = jnp.exp(x)\n"
+        "        p, q = np.asarray(a), np.asarray(a)\n"
+    )
+    assert ids([f for f in fs if f.rule_id == "JIT502"]).count("JIT502") == 1
+
+
+# -- JIT503: use after donate ---------------------------------------------
+
+def test_use_after_donate_flagged():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda c, x: c + x, donate_argnums=(0,))\n"
+        "def f(carry, x):\n"
+        "    out = g(carry, x)\n"
+        "    return carry.sum() + out\n"
+    )
+    assert "JIT503" in ids(fs)
+
+
+def test_rebound_donation_clean():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda c, x: c + x, donate_argnums=(0,))\n"
+        "def f(carry, xs):\n"
+        "    for x in xs:\n"
+        "        carry = g(carry, x)\n"
+        "    return carry\n"
+    )
+    assert "JIT503" not in ids(fs)
+
+
+# -- JIT504: shape-varying slice ------------------------------------------
+
+def test_nonconstant_slice_flagged():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda t: t * 2)\n"
+        "def f(toks, lens):\n"
+        "    for n in lens:\n"
+        "        g(toks[:n])\n"
+    )
+    assert "JIT504" in ids(fs)
+
+
+def test_constant_slice_clean():
+    fs = run(
+        "import jax\n"
+        "g = jax.jit(lambda t: t * 2)\n"
+        "def f(toks, lens):\n"
+        "    for _ in lens:\n"
+        "        g(toks[:16])\n"
+    )
+    assert "JIT504" not in ids(fs)
+
+
+# -- PY500 + pragmas -------------------------------------------------------
+
+def test_syntax_error_is_py500():
+    fs = run("def broken(:\n    pass\n")
+    assert ids(fs) == ["PY500"]
+
+
+def test_inline_allow_suppresses():
+    fs = run(
+        "def f(xs):\n"
+        "    t = 0\n"
+        "    for x in xs:\n"
+        "        t += x.item()  # lint: allow(JIT502)\n"
+        "    return t\n"
+    )
+    assert "JIT502" not in ids(fs)
+
+
+def test_inline_allow_family_prefix():
+    fs = run(
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        x.item()  # lint: allow(JIT)\n"
+    )
+    assert "JIT502" not in ids(fs)
+
+
+# -- rule filtering (--select/--ignore) ------------------------------------
+
+def test_parse_rule_filter():
+    assert parse_rule_filter(" jit502, con6 ") == ("JIT502", "CON6")
+    assert parse_rule_filter(None) == ()
+
+
+def test_rule_selected_prefix_and_ignore_wins():
+    assert rule_selected("JIT502", select=("JIT",))
+    assert not rule_selected("CON600", select=("JIT",))
+    assert not rule_selected("JIT502", select=("JIT",), ignore=("JIT502",))
+    assert rule_selected("JIT501", select=("JIT",), ignore=("JIT502",))
+
+
+def test_filter_findings():
+    fs = run(
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        g = jax.jit(lambda v: v)\n"
+        "        x.item()\n"
+    )
+    only_500 = filter_findings(fs, select=("JIT500",))
+    assert ids(only_500) == ["JIT500"]
+    no_502 = filter_findings(fs, ignore=("JIT502",))
+    assert "JIT502" not in ids(no_502)
+    assert "JIT500" in ids(no_502)
+
+
+# -- golden SARIF ----------------------------------------------------------
+
+def _normalized_sarif(findings):
+    doc = to_sarif(findings)
+    for r in doc["runs"]:
+        r["tool"]["driver"]["version"] = "0"
+    return doc
+
+
+def test_golden_sarif_recompile_fixture():
+    rel = "tests/fixtures/analysis/recompile_static_arg.py"
+    with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+        findings = lint_python_sources([(rel, fh.read())])
+    with open(
+        os.path.join(FIXTURES, "golden_hotpath.sarif.json"), encoding="utf-8"
+    ) as fh:
+        golden = json.load(fh)
+    assert _normalized_sarif(findings) == golden
+
+
+def test_sarif_region_carries_line():
+    fs = run(
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        x.item()\n"
+    )
+    sarif = to_sarif(fs)
+    (res,) = sarif["runs"][0]["results"]
+    region = res["locations"][0]["physicalLocation"]["region"]
+    assert region == {"startLine": 3}
+
+
+def test_sarif_byte_stable():
+    src = (
+        "import jax\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        g = jax.jit(lambda v: v)\n"
+        "        x.item()\n"
+    )
+    a = to_sarif_json(run(src))
+    b = to_sarif_json(run(src))
+    assert a == b

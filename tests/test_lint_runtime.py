@@ -1,0 +1,206 @@
+"""Runtime tripwires: CompileWatch (XLA recompile counting via
+jax.monitoring) and OrderedLock/LockOrderMonitor (runtime lock-order
+inversions, checked standalone and against the static lock graph).
+
+The two lock-order tests are chaos-marked: scripts/chaos_check.py runs
+them 3x and requires this module to contribute — their thread schedules
+are event-sequenced, so outcomes are deterministic."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from devspace_tpu.lint import extract_lock_graph, lint_python_sources
+from devspace_tpu.lint.runtime import (
+    CompileWatch,
+    LockOrderMonitor,
+    OrderedLock,
+    RecompileError,
+)
+
+# -- CompileWatch ----------------------------------------------------------
+
+# The PR 7 bug class as executable code: a Python int in a
+# static_argnums position varies per iteration -> one XLA compile per
+# distinct value. The static rule (JIT501) flags the pattern; the watch
+# counts the compiles actually happening.
+PR7_PATTERN = (
+    "import jax\n"
+    "gather_jit = jax.jit(lambda pool, i: pool[i], static_argnums=(1,))\n"
+    "def drain(pool, ids):\n"
+    "    out = []\n"
+    "    for i in ids:\n"
+    "        out.append(gather_jit(pool, i))\n"
+    "    return out\n"
+)
+
+
+def test_compile_watch_counts_static_arg_recompiles():
+    # fresh lambda per test run: its jit cache starts empty
+    gather_jit = jax.jit(lambda pool, i: pool[i], static_argnums=(1,))
+    pool = jnp.arange(24.0).reshape(6, 4)
+    with CompileWatch("pr7") as watch:
+        gather_jit(pool, 0)  # warmup compiles here are expected
+        watch.reset()
+        for i in (1, 2, 3):
+            gather_jit(pool, i)  # each distinct static value recompiles
+    assert watch.count >= 3
+    with pytest.raises(RecompileError) as e:
+        watch.assert_no_recompiles()
+    assert "pr7" in str(e.value)
+
+
+def test_static_rule_flags_the_same_pattern():
+    # the pattern CompileWatch just caught at runtime is exactly what
+    # JIT501 flags statically — the tripwire and the rule agree
+    findings = lint_python_sources([("pr7.py", PR7_PATTERN)])
+    assert "JIT501" in [f.rule_id for f in findings]
+
+
+def test_compile_watch_zero_after_warmup():
+    step_jit = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(8.0)
+    with CompileWatch("steady") as watch:
+        step_jit(x)
+        watch.reset()
+        for _ in range(5):
+            step_jit(x)  # cache hits: no events
+    assert watch.count == 0
+    watch.assert_no_recompiles()  # must not raise
+
+
+def test_compile_watch_requires_start():
+    watch = CompileWatch()
+    with pytest.raises(RuntimeError):
+        watch.reset()
+    with pytest.raises(RuntimeError):
+        watch.stop()
+
+
+# -- OrderedLock / LockOrderMonitor ----------------------------------------
+
+def test_ordered_lock_basic_and_release_order():
+    mon = LockOrderMonitor()
+    a = OrderedLock("a", mon)
+    b = OrderedLock("b", mon)
+    with a:
+        with b:
+            pass
+    assert mon.ordered_edges() == [("a", "b")]
+    assert mon.violations() == []
+
+
+def test_inversion_detected_single_thread():
+    mon = LockOrderMonitor()
+    a = OrderedLock("a", mon)
+    b = OrderedLock("b", mon)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    vs = mon.violations()
+    assert len(vs) == 1
+    assert (vs[0].outer, vs[0].inner) == ("b", "a")
+    mon.reset()
+    assert mon.violations() == []
+    assert mon.ordered_edges() == []
+
+
+def test_reentrant_ordered_lock_no_self_edge():
+    mon = LockOrderMonitor()
+    a = OrderedLock("a", mon, reentrant=True)
+    with a:
+        with a:
+            pass
+    assert mon.ordered_edges() == []
+    assert mon.violations() == []
+
+
+@pytest.mark.chaos
+def test_lock_inversion_across_threads_chaos():
+    """Two threads take the same pair in opposite orders — sequenced by
+    events so neither ever blocks on the other (no real deadlock, fully
+    deterministic), yet the monitor still reports the inversion."""
+    mon = LockOrderMonitor()
+    a = OrderedLock("alloc", mon)
+    b = OrderedLock("stats", mon)
+    t1_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        t1_done.wait(5)  # strictly after t1 released both
+        with b:
+            with a:
+                pass
+
+    threads = [
+        threading.Thread(target=t1, name="t1"),
+        threading.Thread(target=t2, name="t2"),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    vs = mon.violations()
+    assert len(vs) == 1
+    assert (vs[0].outer, vs[0].inner) == ("stats", "alloc")
+    assert vs[0].thread == "t2"
+    assert vs[0].source == "runtime"
+
+
+STATIC_SRC = (
+    "import threading\n"
+    "class Pool:\n"
+    "    def __init__(self):\n"
+    "        self._alloc = threading.Lock()\n"
+    "        self._stats = threading.Lock()\n"
+    "    def take(self):\n"
+    "        with self._alloc:\n"
+    "            with self._stats:\n"
+    "                pass\n"
+)
+
+
+@pytest.mark.chaos
+def test_runtime_order_vs_static_graph_chaos():
+    """The static graph declares _alloc -> _stats; a runtime schedule
+    acquiring _stats -> _alloc is an inversion of the declared
+    discipline even though no runtime thread ever saw both orders."""
+    graph = extract_lock_graph("pool.py", STATIC_SRC)
+    assert ("_alloc", "_stats") in graph.edges
+
+    mon = LockOrderMonitor()
+    alloc = OrderedLock("_alloc", mon)
+    stats = OrderedLock("_stats", mon)
+
+    # conforming schedule: no violations either way
+    with alloc:
+        with stats:
+            pass
+    assert mon.compare(graph) == []
+    mon.reset()
+
+    # inverted schedule, run on a worker thread
+    def worker():
+        with stats:
+            with alloc:
+                pass
+
+    t = threading.Thread(target=worker, name="w")
+    t.start()
+    t.join(timeout=10)
+    vs = mon.compare(graph)
+    assert len(vs) == 1
+    assert (vs[0].outer, vs[0].inner) == ("_stats", "_alloc")
+    assert vs[0].source == "static"
+    # runtime-only dedup: the same inversion is not double-reported
+    assert mon.violations() == []
