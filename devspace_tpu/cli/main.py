@@ -1143,6 +1143,125 @@ def cmd_collector(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """``fleet serve``: run a local replica fleet (ISSUE 18) — N serving
+    subprocesses under the session supervisor (health-probed, restarted
+    under the retry ladder, drained before any scale-down kill), an
+    embedded telemetry collector federating them on ``--port``, and —
+    with ``--autoscale`` — the closed autoscale loop driving replica
+    count from the collector's HPA signals. ``fleet status`` renders a
+    running fleet's collector view (``/debug/fleet``) as a table."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    from ..utils import log as logutil
+
+    log = logutil.get_logger()
+    if args.what == "status":
+        url = args.url.rstrip("/") + "/debug/fleet"
+        try:
+            with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+                doc = _json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            log.error("no fleet collector endpoint at %s: %s", args.url, e)
+            return 1
+        rows = doc.get("targets", [])
+        up = sum(1 for r in rows if r.get("up"))
+        print(f"fleet: {up}/{len(rows)} replica(s) up")
+        fmt = "%-14s %-4s %-11s %9s %9s %7s"
+        print(fmt % ("REPLICA", "UP", "QUARANTINED", "TOK/S", "OCCUP", "QUEUED"))
+        for r in rows:
+            def num(v, spec="%.2f"):
+                return spec % v if isinstance(v, (int, float)) else "-"
+
+            print(fmt % (
+                r.get("target"), "yes" if r.get("up") else "NO",
+                "yes" if r.get("quarantined") else "no",
+                num(r.get("tok_s"), "%.1f"), num(r.get("occupancy")),
+                num(r.get("queued"), "%.0f"),
+            ))
+        for sig in (doc.get("hpa") or {}).get("metrics", []):
+            pods = sig.get("pods") or {}
+            print("hpa signal: %s averageValue=%s" % (
+                (pods.get("metric") or {}).get("name"),
+                (pods.get("target") or {}).get("averageValue"),
+            ))
+        return 0
+
+    from ..obs.collector import TelemetryCollector, make_http_server
+    from ..serving import ReplicaFleet, ReplicaSpec
+    from ..serving.autoscale import AutoscaleLoop, AutoscalerConfig
+
+    env = {}
+    for kv in args.env or []:
+        if "=" not in kv:
+            log.error("--env wants KEY=VALUE, got %r", kv)
+            return 1
+        k, _, v = kv.partition("=")
+        env[k] = v
+    spec = ReplicaSpec(module=args.module, env=env)
+    fleet = ReplicaFleet(
+        spec=spec, replicas=args.replicas,
+        restart_budget=args.restart_budget,
+        healthy_window_s=args.healthy_window,
+    )
+    fleet.start()
+    collector = TelemetryCollector.from_replicas([], interval_s=args.interval)
+    collector.refresh(sorted(fleet.targets().items()))
+    collector.scrape_once()
+    httpd = make_http_server(collector, args.host, args.port)
+    loop = None
+    if args.autoscale:
+        loop = AutoscaleLoop(
+            fleet, collector,
+            AutoscalerConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                targets={args.metric: args.target_value},
+                scale_down_stabilization_s=args.scale_down_window,
+            ),
+            interval_s=args.interval,
+            on_decision=lambda d: (
+                log.info(
+                    "[autoscale] %d -> %d (%s)", d.current, d.desired, d.reason
+                ) if d.desired != d.current else None
+            ),
+        )
+    collector.start()
+    if loop is not None:
+        loop.start()
+    log.done(
+        "fleet of %d replica(s) up (module %s); collector on "
+        "http://%s:%d%s",
+        args.replicas, args.module, args.host, httpd.server_address[1],
+        f"; autoscaling {args.min_replicas}-{args.max_replicas} on "
+        f"{args.metric}<={args.target_value:g}" if args.autoscale else "",
+    )
+    import threading
+
+    server_thread = threading.Thread(
+        target=httpd.serve_forever, daemon=True)
+    server_thread.start()
+    try:
+        if args.duration:
+            _time.sleep(args.duration)
+        else:
+            while True:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if loop is not None:
+            loop.stop()
+        collector.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        fleet.stop()
+        log.done("fleet stopped (%s)", fleet.supervisor.status_line())
+    return 0
+
+
 # -- config mutation (add/remove) ------------------------------------------
 def _load_for_edit(args) -> tuple[Context, latest.Config]:
     ctx = Context(args)
@@ -2340,6 +2459,96 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve N HTTP requests then exit (0 = run until Ctrl-C)",
     )
     q.set_defaults(fn=cmd_collector)
+
+    sp = sub.add_parser(
+        "fleet",
+        help="replica fleet: N supervised serving processes with "
+        "drain-aware scaling and an embedded collector",
+    )
+    fleet_sub = sp.add_subparsers(dest="what", required=True)
+    q = fleet_sub.add_parser(
+        "serve",
+        help="run N replicas under the supervisor, federate them via an "
+        "embedded collector, optionally autoscale from its HPA signals",
+    )
+    q.add_argument(
+        "--replicas", type=int, default=2, help="initial replica count",
+    )
+    q.add_argument(
+        "--module",
+        default="devspace_tpu.serving.stub",
+        help="replica entrypoint, launched as `python -m MODULE --port N`",
+    )
+    q.add_argument(
+        "--env",
+        action="append",
+        metavar="KEY=VALUE",
+        help="extra environment for replica processes (repeatable)",
+    )
+    q.add_argument(
+        "--restart-budget",
+        type=int,
+        default=None,
+        help="cumulative replica restarts before degrading (default "
+        "unlimited)",
+    )
+    q.add_argument(
+        "--healthy-window",
+        type=float,
+        default=60.0,
+        help="seconds of continuous health that reset the restart budget",
+    )
+    q.add_argument("--host", default="127.0.0.1", help="collector bind address")
+    q.add_argument("--port", type=int, default=9090, help="collector port")
+    q.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="scrape + autoscale evaluation interval (seconds)",
+    )
+    q.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="drive replica count from the collector's HPA signals",
+    )
+    q.add_argument("--min-replicas", type=int, default=1)
+    q.add_argument("--max-replicas", type=int, default=4)
+    q.add_argument(
+        "--metric",
+        default="engine_dispatch_depth_occupancy",
+        help="HPA signal to track (autoscaling/v2 Pods metric name)",
+    )
+    q.add_argument(
+        "--target-value",
+        type=float,
+        default=0.75,
+        help="target per-replica average for --metric",
+    )
+    q.add_argument(
+        "--scale-down-window",
+        type=float,
+        default=30.0,
+        help="scale-down stabilization window (seconds)",
+    )
+    q.add_argument(
+        "--duration",
+        type=float,
+        default=0,
+        help="run N seconds then exit (0 = run until Ctrl-C)",
+    )
+    q.set_defaults(fn=cmd_fleet)
+    q = fleet_sub.add_parser(
+        "status",
+        help="one-shot fleet table from a running fleet's collector "
+        "(/debug/fleet)",
+    )
+    q.add_argument(
+        "--url",
+        default="http://127.0.0.1:9090",
+        help="fleet collector base URL",
+    )
+    q.add_argument("--timeout", type=float, default=3.0)
+    q.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("add", help="add config entries")
     add_sub = sp.add_subparsers(dest="kind", required=True)

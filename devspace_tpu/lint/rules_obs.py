@@ -41,6 +41,7 @@ _UNITLESS_GAUGE_SUFFIXES = (
     "_targets_up",
     "_up",
     "_quarantined",
+    "_replicas",
 )
 _RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
 _KINDS = ("counter", "gauge", "histogram")
@@ -57,6 +58,7 @@ def load_metric_catalogs() -> dict:
     from devspace_tpu.obs.slo import SLO_METRIC_FAMILIES
     from devspace_tpu.obs.tracing import TRACING_METRIC_FAMILIES
     from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
+    from devspace_tpu.serving.fleet import FLEET_METRIC_FAMILIES
     from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
     from devspace_tpu.utils.trace import TRACE_METRIC_FAMILIES
 
@@ -70,6 +72,7 @@ def load_metric_catalogs() -> dict:
         "events": EVENTS_METRIC_FAMILIES,
         "slo": SLO_METRIC_FAMILIES,
         "collector": COLLECTOR_METRIC_FAMILIES,
+        "fleet": FLEET_METRIC_FAMILIES,
     }
 
 

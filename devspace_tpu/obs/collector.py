@@ -227,6 +227,34 @@ class TelemetryCollector:
         """Static serving-replica URL list (the ``--target`` CLI path)."""
         return cls(list(urls), **kwargs)
 
+    def refresh(self, targets: Sequence[Union[str, tuple]]) -> None:
+        """Replace the target set at runtime (ISSUE 18: the autoscaler
+        adds/removes replicas and a restarted replica may come back on a
+        new port) without rebuilding the collector. State is preserved
+        per *name*: a surviving target keeps its quarantine, staleness
+        and last-good-snapshot state (a URL change just repoints the
+        same TargetState — the next scrape round re-probes it); new
+        names start cold; dropped names are forgotten. The list is
+        swapped atomically, so a concurrent ``scrape_once`` finishes
+        its round over the old set and the gauge callbacks pick up the
+        new one on their next read."""
+        by_name = {t.name: t for t in self.targets}
+        fresh: list[TargetState] = []
+        for t in targets:
+            if isinstance(t, str):
+                name, url = _target_name(t), t
+            else:
+                name, url = t
+            state = by_name.get(name)
+            if state is not None:
+                state.url = url.rstrip("/")
+            else:
+                state = TargetState(name, url)
+            fresh.append(state)
+        if len({t.name for t in fresh}) != len(fresh):
+            raise ValueError("duplicate target names")
+        self.targets = fresh
+
     @classmethod
     def from_workers(
         cls,

@@ -53,8 +53,10 @@ EVENT_SUBSYSTEMS = (
     "cli",
     "dispatch",
     "engine",
+    "fleet",
     "kv_tier",
     "resilience",
+    "serving",
     "slo",
     "supervisor",
     "sync",
@@ -72,6 +74,11 @@ EVENT_CATALOG = (
     ("engine", "poisoned_window", "Dispatched decode window raised; pool reset"),
     ("engine", "fail_outstanding", "Engine failing all outstanding requests"),
     ("engine", "request_failed", "One request failed (admission, prefill or decode)"),
+    ("fleet", "scale_up", "Fleet manager adding replicas toward a higher target size"),
+    ("fleet", "scale_down", "Fleet manager draining and removing surplus replicas"),
+    ("fleet", "replica_started", "Fleet replica spawned and passed its readiness probe"),
+    ("fleet", "replica_restarted", "Dead fleet replica restarted under the retry policy"),
+    ("fleet", "replica_removed", "Fleet replica drained and terminated during scale-down"),
     ("kv_tier", "spill", "Evicted prefix blocks spilled to a lower KV tier"),
     ("kv_tier", "restore", "Spilled prefix blocks restored into the device pool"),
     ("kv_tier", "restore_fallback", "Tier restore failed; prefix recomputed"),
@@ -79,6 +86,8 @@ EVENT_CATALOG = (
     ("resilience", "circuit_open", "Circuit breaker opened after repeated failures"),
     ("resilience", "circuit_close", "Circuit breaker closed after a probe success"),
     ("resilience", "retries_exhausted", "Retry policy gave up after max attempts"),
+    ("serving", "drain_started", "Serving process entered drain mode (readyz 503, healthz live)"),
+    ("serving", "drain_cleared", "Serving process left drain mode and readmits traffic"),
     ("slo", "warn", "SLO burn rate crossed the warn threshold"),
     ("slo", "breach", "SLO burn rate crossed the breach threshold"),
     ("slo", "recovered", "SLO returned to ok from warn/breach"),
@@ -87,6 +96,7 @@ EVENT_CATALOG = (
     ("supervisor", "restarting", "Supervisor restarting a dead service"),
     ("supervisor", "restarted", "Supervised service restarted successfully"),
     ("supervisor", "degraded", "Service exceeded restart budget; running degraded"),
+    ("supervisor", "budget_reset", "Service stayed healthy past its window; restart budget reset"),
     ("supervisor", "failed", "Supervised service failed permanently"),
     ("supervisor", "exited", "Supervised service exited cleanly"),
     ("supervisor", "stopped", "Supervisor stopped a service"),

@@ -18,6 +18,22 @@ is one component with one policy):
   one (sync — it owns correctness of the slice state) escalates: the
   supervisor records a fatal error and the dev loop exits.
 
+Two budgets bound restarts (ISSUE 18):
+
+- **per-episode**: consecutive *failed* restart attempts after one death
+  are bounded by the policy's ``max_attempts`` (a service whose factory
+  keeps raising gives up after the backoff ladder);
+- **cumulative** (opt-in via ``restart_budget``): *successful* restarts
+  also count, so a crash-looping service that restarts cleanly every
+  time still degrades instead of flapping forever. Staying continuously
+  healthy past ``healthy_window_s`` resets the cumulative count — a
+  replica that crashes once a day is never marked failed, only one that
+  crashes faster than it can prove itself healthy.
+
+Services may also be added (``add`` + ``start_service``) and removed
+(``remove``) while the monitor is running — the seam the replica fleet
+manager (devspace_tpu/serving/fleet.py) scales through.
+
 State machine per service::
 
     starting -> running -> (probe fails) -> restarting -> running
@@ -83,6 +99,8 @@ class _Service:
         failure: Optional[Callable[[object], Optional[str]]],
         critical: bool,
         policy: RetryPolicy,
+        restart_budget: Optional[int] = None,
+        healthy_window_s: Optional[float] = None,
     ):
         self.name = name
         self.factory = factory
@@ -91,9 +109,14 @@ class _Service:
         self.failure = failure
         self.critical = critical
         self.policy = policy
+        self.restart_budget = restart_budget
+        self.healthy_window_s = healthy_window_s
         self.handle: object = None
         self.state = ServiceState.STARTING
         self.restarts = 0
+        self.budget_used = 0  # successful restarts since the last reset
+        self.running_since: Optional[float] = None
+        self.removed = False
         self.last_error: Optional[str] = None
         self._delays: Optional[Iterator[float]] = None
         self._attempts = 0
@@ -183,14 +206,25 @@ class SessionSupervisor:
         failure: Optional[Callable[[object], Optional[str]]] = None,
         critical: bool = False,
         policy: Optional[RetryPolicy] = None,
+        restart_budget: Optional[int] = None,
+        healthy_window_s: Optional[float] = None,
     ) -> None:
         """Register a service. ``factory`` creates AND starts it, returning
         a handle; ``probe(handle)`` is its liveness check (defaults to
         ``handle.alive()`` when present, else always-healthy);
         ``failure(handle)`` classifies a death (error string, or None for a
         clean exit); ``stop(handle)`` tears it down (defaults to
-        ``handle.stop()``)."""
+        ``handle.stop()``).
+
+        ``restart_budget`` caps *cumulative* successful restarts (None =
+        unlimited, the historical behavior): a service that keeps crash-
+        looping exhausts it and degrades/fails instead of flapping
+        forever. ``healthy_window_s`` resets that budget once the service
+        stays continuously healthy that long — an occasional crash never
+        accumulates toward the cap."""
         with self._lock:
+            if any(s.name == name for s in self._services):
+                raise ValueError(f"duplicate service name {name!r}")
             self._services.append(
                 _Service(
                     name,
@@ -200,8 +234,57 @@ class SessionSupervisor:
                     failure,
                     critical,
                     policy or self.default_policy,
+                    restart_budget,
+                    healthy_window_s,
                 )
             )
+
+    def start_service(self, name: str) -> object:
+        """Start one registered-but-unstarted service (the scale-up path:
+        ``add`` then ``start_service`` on a supervisor whose monitor is
+        already running). Factory exceptions propagate — startup failures
+        are loud here exactly like in :meth:`start`. Returns the handle."""
+        with self._lock:
+            svc = next(
+                (s for s in self._services if s.name == name), None)
+        if svc is None:
+            raise KeyError(f"unknown service {name!r}")
+        if svc.handle is not None or svc.state != ServiceState.STARTING:
+            raise ValueError(f"service {name!r} already started")
+        svc.handle = svc.factory()
+        svc.state = ServiceState.RUNNING
+        svc.running_since = self._clock()
+        self._emit(svc.name, "started")
+        return svc.handle
+
+    def remove(self, name: str, stop: bool = True) -> object:
+        """Deregister a service (the scale-down path). The monitor stops
+        probing it immediately; with ``stop`` (default) its handle is torn
+        down too. Callers that drain before terminating pass
+        ``stop=False`` and own the handle's shutdown. Returns the handle."""
+        with self._lock:
+            svc = next(
+                (s for s in self._services if s.name == name), None)
+            if svc is None:
+                raise KeyError(f"unknown service {name!r}")
+            svc.removed = True
+            self._services = [s for s in self._services if s is not svc]
+        if stop and svc.state in (
+            ServiceState.RUNNING, ServiceState.RESTARTING
+        ):
+            svc.stop_handle()
+        svc.state = ServiceState.STOPPED
+        self._emit(svc.name, "stopped", "removed")
+        return svc.handle
+
+    def handle(self, name: str) -> object:
+        """The current handle for ``name`` (None while restarting after a
+        failed attempt)."""
+        with self._lock:
+            for s in self._services:
+                if s.name == name:
+                    return s.handle
+        return None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -219,6 +302,7 @@ class SessionSupervisor:
         for svc in services:
             svc.handle = svc.factory()
             svc.state = ServiceState.RUNNING
+            svc.running_since = self._clock()
             self._emit(svc.name, "started")
         self._monitor_thread = threading.Thread(
             target=self._monitor, daemon=True, name="session-supervisor"
@@ -251,8 +335,26 @@ class SessionSupervisor:
                     )
 
     def _check(self, svc: _Service) -> None:
+        if svc.removed:
+            return
         if svc.state == ServiceState.RUNNING:
             if svc.healthy():
+                # cumulative-budget reset: continuously healthy past the
+                # window proves the service stable again, so an
+                # occasional crash (once a day, say) never accumulates
+                # toward the restart_budget cap (ISSUE 18 satellite)
+                if (
+                    svc.healthy_window_s is not None
+                    and svc.budget_used
+                    and svc.running_since is not None
+                    and self._clock() - svc.running_since
+                    >= svc.healthy_window_s
+                ):
+                    svc.budget_used = 0
+                    self._emit(
+                        svc.name, "budget_reset",
+                        f"healthy for {svc.healthy_window_s:g}s",
+                    )
                 return
             reason = svc.failure_reason()
             if reason is None:
@@ -275,6 +377,17 @@ class SessionSupervisor:
                 self._attempt_restart(svc)
 
     def _begin_restart(self, svc: _Service) -> None:
+        if (
+            svc.restart_budget is not None
+            and svc.budget_used >= svc.restart_budget
+        ):
+            self._give_up(
+                svc,
+                f"{svc.last_error or 'died'} (cumulative restart budget "
+                f"of {svc.restart_budget} exhausted without a "
+                f"{svc.healthy_window_s or 0:g}s healthy window)",
+            )
+            return
         svc.state = ServiceState.RESTARTING
         svc._delays = svc.policy.delays()
         svc._attempts = 0
@@ -299,6 +412,8 @@ class SessionSupervisor:
             return
         svc.state = ServiceState.RUNNING
         svc.restarts += 1
+        svc.budget_used += 1
+        svc.running_since = self._clock()
         svc._delays = None
         self._emit(svc.name, "restarted", f"restart #{svc.restarts}")
 
@@ -348,6 +463,8 @@ class SessionSupervisor:
                     "state": s.state,
                     "critical": s.critical,
                     "restarts": s.restarts,
+                    "budget_used": s.budget_used,
+                    "restart_budget": s.restart_budget,
                     "last_error": s.last_error,
                 }
                 for s in self._services

@@ -1,0 +1,35 @@
+"""Serving-tier robustness layer: replica fleet, autoscaler, loadgen.
+
+The single-process serving example (examples/llama-inference/serve.py)
+proves the engine; this package wraps it in production weather — a
+replica fleet manager restarting and draining serve processes under the
+session supervisor (:mod:`.fleet`), a closed-loop autoscaler driving
+replica count from collector HPA signals (:mod:`.autoscale`), an
+open-loop traffic generator with per-request outcome accounting
+(:mod:`.loadgen`), and a deterministic stub replica that makes all of
+it testable in milliseconds (:mod:`.stub`).
+"""
+
+from .autoscale import (  # noqa: F401
+    AutoscaleDecision,
+    Autoscaler,
+    AutoscalerConfig,
+)
+from .fleet import (  # noqa: F401
+    FLEET_METRIC_FAMILIES,
+    PROBE_ALIVE,
+    PROBE_DEAD,
+    PROBE_READY,
+    Replica,
+    ReplicaFleet,
+    ReplicaSpec,
+    free_port,
+    spawn_replica,
+)
+from .loadgen import (  # noqa: F401
+    LoadGenerator,
+    LoadReport,
+    RequestOutcome,
+    TraceSpec,
+    generate_trace,
+)

@@ -1,0 +1,268 @@
+"""Deterministic stub serving replica — the fleet layer's crash-test dummy.
+
+Speaks the llama-inference example's serving protocol (``/generate``
+with ndjson streaming, ``/healthz``, ``/readyz``, ``POST /drain``,
+``/metrics`` in Prometheus 0.0.4 with the engine family names the
+collector/autoscaler read, ``/debug/events``) but replaces the JAX
+engine with a deterministic token generator, so a 3-replica fleet boots
+in well under a second and every byte of every stream is predictable:
+
+    token_at(prompt_ids, i)  ==  the i-th token any healthy replica emits
+
+That predictability is what lets the chaos gate
+(scripts/chaos_serving_check.py) and the loadgen assert **zero
+corrupted streams** — a surviving stream must carry exactly the
+expected token sequence; anything else is corruption, not bad luck.
+
+Chaos is first-class: ``POST /chaos`` flips failure modes at runtime —
+
+- ``{"hang": true}``        — /readyz and /healthz handlers block
+  (simulates a wedged process: alive but unresponsive; the fleet
+  manager's probe must time out and restart it)
+- ``{"metrics_garbage": true}`` — /metrics returns non-exposition bytes
+  (the collector must quarantine, never corrupt the merge)
+- ``{"exit": N}``           — process exits with code N
+
+Env knobs: ``PORT``, ``STUB_MAX_SLOTS`` (admission concurrency, default
+4), ``STUB_TOKEN_DELAY_S`` (per-token sleep, default 0.02 — requests
+may override with a ``token_delay_s`` field), ``STUB_STARTUP_DELAY_S``
+(sleep before binding, for ready-timeout tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..obs import events as obs_events
+from ..obs.metrics import Registry, WindowedRate
+
+VOCAB = 50_000
+
+
+def token_at(prompt_ids, i: int) -> int:
+    """The i-th output token for ``prompt_ids`` — shared contract between
+    stub replicas and stream verifiers (loadgen, the chaos gate). Any
+    deviation observed by a client is stream corruption by definition."""
+    seed = 0
+    for t in prompt_ids:
+        seed = (seed * 31 + int(t) + 7) % VOCAB
+    return (seed + 13 * (i + 1)) % VOCAB
+
+
+class StubState:
+    """Counters + chaos flags shared across handler threads."""
+
+    def __init__(self, max_slots: int = 4):
+        self.max_slots = max(1, int(max_slots))
+        self.lock = threading.Lock()
+        self.active = 0
+        self.queued = 0
+        self.completed = 0
+        self.failed = 0
+        self.draining = os.environ.get("DEVSPACE_DRAIN", "0") == "1"
+        self.hang = False
+        self.metrics_garbage = False
+        self.slots = threading.Semaphore(self.max_slots)
+
+        self.registry = Registry()
+        reg = self.registry
+        self.m_completed = reg.counter(
+            "engine_requests_completed_total", "Requests finished")
+        self.m_failed = reg.counter(
+            "engine_requests_failed_total", "Requests failed")
+        self.rate = WindowedRate(10.0)
+        reg.register_callback(
+            "engine_tokens_per_sec_10s", "gauge",
+            "Emitted tokens/s over a 10s window", self.rate.rate)
+        reg.register_callback(
+            "engine_active_slots", "gauge", "In-flight requests",
+            lambda: self.active)
+        reg.register_callback(
+            "engine_max_slots", "gauge", "Admission concurrency",
+            lambda: self.max_slots)
+        reg.register_callback(
+            "engine_queued_requests", "gauge",
+            "Requests waiting for a slot", lambda: self.queued)
+        reg.register_callback(
+            "engine_dispatch_depth_occupancy", "gauge",
+            "Slot occupancy fraction",
+            lambda: self.active / self.max_slots)
+        self.ttft = reg.histogram("ttft_seconds", "Time to first token")
+        self.e2e = reg.histogram("request_e2e_seconds", "End-to-end latency")
+
+
+def main(argv=None) -> int:
+    import argparse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("PORT", 8000)))
+    args = ap.parse_args(argv)
+
+    startup_delay = float(os.environ.get("STUB_STARTUP_DELAY_S", 0))
+    if startup_delay:
+        time.sleep(startup_delay)
+
+    state = StubState(max_slots=int(os.environ.get("STUB_MAX_SLOTS", 4)))
+    default_delay = float(os.environ.get("STUB_TOKEN_DELAY_S", 0.02))
+    flight = obs_events.add_sink(obs_events.FlightRecorder(per_subsystem=128))
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802 — quiet
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            path = self.path.partition("?")[0]
+            if path in ("/healthz", "/readyz") and state.hang:
+                # wedged-process simulation: the handler blocks until the
+                # probe side gives up (daemon_threads, so exit still works)
+                time.sleep(3600)
+                return
+            if path == "/healthz":
+                self._json(200, {
+                    "ok": True,
+                    "model": "stub",
+                    "draining": state.draining,
+                    "active_requests": state.active,
+                    "queued_requests": state.queued,
+                    "requests_completed": state.completed,
+                    "requests_failed": state.failed,
+                    "max_slots": state.max_slots,
+                })
+            elif path == "/readyz":
+                ready = not state.draining
+                self._json(200 if ready else 503,
+                           {"ready": ready, "draining": state.draining})
+            elif path == "/metrics":
+                if state.metrics_garbage:
+                    body = b"!! this is not a prometheus exposition !!\n\x00"
+                else:
+                    body = state.registry.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/debug/events":
+                self._json(200, {
+                    "events_enabled": True,
+                    "subsystems": flight.subsystems(),
+                    "events": flight.dump_dicts(None, 200),
+                })
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length)) if length else {}
+            except (ValueError, json.JSONDecodeError):
+                self._json(400, {"error": "body must be JSON"})
+                return
+            if self.path == "/drain":
+                off = bool(req.get("off"))
+                changed = state.draining == off
+                state.draining = not off
+                if changed:
+                    obs_events.emit(
+                        "serving",
+                        "drain_cleared" if off else "drain_started",
+                        level="info" if off else "warn", pid=os.getpid(),
+                    )
+                self._json(200, {"draining": state.draining})
+            elif self.path == "/chaos":
+                if "hang" in req:
+                    state.hang = bool(req["hang"])
+                if "metrics_garbage" in req:
+                    state.metrics_garbage = bool(req["metrics_garbage"])
+                self._json(200, {
+                    "hang": state.hang,
+                    "metrics_garbage": state.metrics_garbage,
+                })
+                if "exit" in req:
+                    os._exit(int(req["exit"]))
+            elif self.path == "/generate":
+                self._generate(req)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def _generate(self, req):
+            try:
+                prompt = [int(t) for t in req["prompt_ids"]]
+                n = int(req.get("max_new_tokens", 16))
+                delay = float(req.get("token_delay_s", default_delay))
+                if n < 1:
+                    raise ValueError("max_new_tokens must be >= 1")
+            except (KeyError, TypeError, ValueError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            t0 = time.monotonic()
+            with state.lock:
+                state.queued += 1
+            state.slots.acquire()
+            with state.lock:
+                state.queued -= 1
+                state.active += 1
+            try:
+                tokens = [token_at(prompt, i) for i in range(n)]
+                if req.get("stream"):
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson")
+                    self.end_headers()
+                    first = True
+                    for tok in tokens:
+                        time.sleep(delay)
+                        if first:
+                            state.ttft.observe(time.monotonic() - t0)
+                            first = False
+                        self.wfile.write(
+                            json.dumps({"token": tok}).encode() + b"\n")
+                        self.wfile.flush()
+                        state.rate.add(1)
+                    self.wfile.write(
+                        json.dumps({"done": True}).encode() + b"\n")
+                else:
+                    time.sleep(delay * n)
+                    state.ttft.observe(time.monotonic() - t0)
+                    state.rate.add(n)
+                    self._json(200, {"tokens": tokens})
+                with state.lock:
+                    state.completed += 1
+                state.m_completed.inc()
+                state.e2e.observe(time.monotonic() - t0)
+            except (ConnectionError, BrokenPipeError):
+                with state.lock:
+                    state.failed += 1
+                state.m_failed.inc()
+            finally:
+                with state.lock:
+                    state.active -= 1
+                state.slots.release()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    httpd.daemon_threads = True  # hung/chaos handlers never block exit
+    print(f"stub replica serving on :{httpd.server_address[1]}", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -5,7 +5,10 @@ by `devspace-tpu analyze`.
 Serves /generate (JSON: {"prompt_ids": [...], "max_new_tokens": N,
 optional "temperature", "eos_id", "top_k", "top_p"}), /healthz (now with
 an "slo" block: multi-window burn-rate statuses per objective),
-/readyz (503 while any SLO is in breach — the load-shed hook),
+/readyz (503 while any SLO is in breach or while draining — the
+load-shed hook), POST /drain (enter/leave drain mode: readyz goes 503
+while healthz stays 200, the fleet manager's graceful scale-down hook;
+{"off": true} clears it),
 /debug/events (flight-recorder dump of recent structured events;
 ?subsystem=engine&limit=N), /debug/config (effective serving config,
 the `debug bundle` member), /metrics
@@ -200,6 +203,12 @@ class Server:
         sources.append(get_registry().snapshot)
         self.slo = obs_slo.SLOEvaluator(specs, sources)
         self.slo.register_metrics(get_registry())
+        # drain mode (ISSUE 18): POST /drain flips /readyz to 503 while
+        # /healthz stays 200, so a fleet manager / LB can stop routing
+        # here ahead of a planned termination WITHOUT faking an SLO
+        # breach. DEVSPACE_DRAIN=1 starts the process already draining
+        # (useful for canary-style spawn-then-admit rollouts).
+        self.draining = os.environ.get("DEVSPACE_DRAIN", "0") == "1"
         self.slo_interval = float(os.environ.get("DEVSPACE_SLO_INTERVAL_S", 5.0))
         threading.Thread(
             target=self._slo_loop, daemon=True, name="slo-eval"
@@ -231,6 +240,7 @@ class Server:
             "checkpoint": os.environ.get("CHECKPOINT"),
             "quantize": os.environ.get("QUANTIZE"),
             "events_enabled": self.flight is not None,
+            "draining": self.draining,
             "slo_interval_s": self.slo_interval,
             "slos": [s.to_dict() for s in self.slo.specs],
         }
@@ -343,18 +353,25 @@ def main(argv=None):
                     {
                         "ok": True,
                         "model": os.environ.get("MODEL", "tiny"),
+                        "draining": server.draining,
                         "slo": server.slo.to_dict(),
                         **server.engine.stats(),
                     },
                 )
             elif path == "/readyz":
                 # the load-shed signal: not-ready while any SLO is in
-                # breach (multi-window burn rate, obs/slo.py) — a probe
-                # or LB can stop routing here without killing the pod
+                # breach (multi-window burn rate, obs/slo.py) OR while
+                # the process is draining (POST /drain) — a probe or LB
+                # can stop routing here without killing the pod
                 # (liveness stays /healthz)
                 slo = server.slo.to_dict()
-                code = 200 if slo["ready"] else 503
-                self._json(code, {"ready": slo["ready"], "slo": slo})
+                ready = slo["ready"] and not server.draining
+                code = 200 if ready else 503
+                self._json(code, {
+                    "ready": ready,
+                    "draining": server.draining,
+                    "slo": slo,
+                })
             elif path == "/debug/events":
                 # flight-recorder dump: ?subsystem=engine limits to one
                 # ring, ?limit=N keeps the newest N (oldest first)
@@ -498,6 +515,28 @@ def main(argv=None):
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            if self.path == "/drain":
+                # explicit drain toggle for the fleet manager's graceful
+                # scale-down: {"off": true} clears it, anything else (or
+                # an empty body) enters drain mode. Idempotent.
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length)) if length else {}
+                except (ValueError, json.JSONDecodeError):
+                    self._json(400, {"error": "body must be JSON"})
+                    return
+                off = bool(body.get("off"))
+                changed = server.draining == off
+                server.draining = not off
+                if changed:
+                    obs_events.emit(
+                        "serving",
+                        "drain_cleared" if off else "drain_started",
+                        level="info" if off else "warn",
+                        pid=os.getpid(),
+                    )
+                self._json(200, {"draining": server.draining})
+                return
             if self.path == "/generate_speculative":
                 # greedy-only draft/verify decoding THROUGH the engine's
                 # paged speculative path; lossless vs /generate at

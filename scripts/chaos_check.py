@@ -64,6 +64,12 @@ REQUIRED_CHAOS_MODULES = (
     # runtime order contradicting the static lock graph must be flagged
     # even though no thread ever saw both orders
     "test_lint_runtime",
+    # replica fleet recovery (ISSUE 18): a SIGKILLed replica must be
+    # respawned with replica_restarted on the event trail, a wedged
+    # (hung-probe) replica must be classified dead and replaced, and a
+    # budget-exhausted replica must degrade while survivors keep
+    # serving verified streams
+    "test_serving_fleet",
 )
 
 

@@ -291,3 +291,134 @@ def test_default_probe_uses_handle_alive():
         assert svc_row(sup, "svc")["last_error"] == "imploded"
     finally:
         sup.stop()
+
+
+# -- cumulative restart budget + healthy-window reset (ISSUE 18) ------------
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_restart_budget_exhaustion_degrades():
+    # restart_budget counts SUCCESSFUL restarts: a service that restarts
+    # cleanly every time still degrades once the cumulative cap is hit
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    sup = make_supervisor(restart=RESTART_ALWAYS)
+    sup.add("svc", factory, restart_budget=2)
+    sup.start()
+    try:
+        for i in range(2):
+            made[-1].die("crash-loop")
+            wait_for(
+                lambda i=i: svc_row(sup, "svc")["restarts"] == i + 1,
+                msg=f"restart #{i + 1}",
+            )
+        assert svc_row(sup, "svc")["budget_used"] == 2
+        made[-1].die("crash-loop")
+        wait_for(
+            lambda: svc_row(sup, "svc")["state"] == ServiceState.DEGRADED,
+            msg="budget exhaustion degrades",
+        )
+        assert "restart budget" in svc_row(sup, "svc")["last_error"] or any(
+            "budget" in e.detail for e in sup.events if e.kind == "degraded"
+        )
+        # only 2 of the 3 deaths were allowed to restart
+        assert svc_row(sup, "svc")["restarts"] == 2
+    finally:
+        sup.stop()
+
+
+def test_healthy_window_resets_restart_budget():
+    # the pin for the ISSUE 18 satellite: staying continuously healthy
+    # past healthy_window_s zeroes budget_used, so an occasional crash
+    # never accumulates toward the cap. Driven through _check with an
+    # injected clock — no wall-time dependence.
+    made = []
+
+    def factory():
+        s = FakeService()
+        made.append(s)
+        return s
+
+    clk = FakeClock()
+    sup = SessionSupervisor(
+        restart=RESTART_ALWAYS,
+        poll_interval=0.01,
+        default_policy=RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0),
+        clock=clk.now,
+    )
+    sup.add("svc", factory, restart_budget=1, healthy_window_s=10.0)
+    with sup._lock:
+        svc = sup._services[0]
+    svc.handle = svc.factory()
+    svc.state = ServiceState.RUNNING
+    svc.running_since = clk.now()
+
+    # death -> immediate successful restart consumes the whole budget
+    made[-1].die("crash")
+    sup._check(svc)  # RUNNING -> RESTARTING (budget 0/1 used, allowed)
+    sup._check(svc)  # restart attempt succeeds
+    assert svc.state == ServiceState.RUNNING
+    assert svc.budget_used == 1
+
+    # healthy but window not yet elapsed: budget stays consumed
+    clk.advance(9.0)
+    sup._check(svc)
+    assert svc.budget_used == 1
+
+    # continuously healthy past the window: budget resets + event emitted
+    clk.advance(1.5)
+    sup._check(svc)
+    assert svc.budget_used == 0
+    assert any(e.kind == "budget_reset" for e in sup.events)
+
+    # the NEXT crash gets a fresh budget instead of degrading
+    made[-1].die("crash-after-quiet-day")
+    sup._check(svc)
+    sup._check(svc)
+    assert svc.state == ServiceState.RUNNING
+    assert svc.budget_used == 1
+
+
+def test_dynamic_add_start_remove():
+    # the fleet-manager seam: services join and leave a live supervisor
+    sup = make_supervisor(restart=RESTART_ALWAYS)
+    first = FakeService()
+    sup.add("a", lambda: first)
+    sup.start()
+    try:
+        late = FakeService()
+        sup.add("b", lambda: late, restart_budget=5)
+        handle = sup.start_service("b")
+        assert handle is late
+        assert svc_row(sup, "b")["state"] == ServiceState.RUNNING
+        with pytest.raises(ValueError):
+            sup.start_service("b")  # double start
+        with pytest.raises(KeyError):
+            sup.start_service("ghost")
+        with pytest.raises(ValueError):
+            sup.add("b", lambda: FakeService())  # duplicate name
+
+        removed = sup.remove("b")
+        assert removed is late
+        assert late.stops == 1  # remove(stop=True) tore the handle down
+        assert all(r["service"] != "b" for r in sup.status())
+        # the monitor must not resurrect a removed service
+        time.sleep(0.05)
+        assert late.stops == 1
+        with pytest.raises(KeyError):
+            sup.remove("b")
+    finally:
+        sup.stop()

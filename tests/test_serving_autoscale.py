@@ -1,0 +1,221 @@
+"""Autoscaler decision-table goldens.
+
+Every expectation here is hand-computed from the HPA formula
+``desired = ceil(current * value / target)`` plus the tolerance band and
+the stabilization-window rules documented in
+devspace_tpu/serving/autoscale.py. The clock is injected, so the table
+is exact — no sleeps, no wall time.
+"""
+
+import pytest
+
+from devspace_tpu.serving import Autoscaler, AutoscalerConfig
+from devspace_tpu.serving.autoscale import AutoscaleLoop, signal_values
+
+
+def sig(value, name="occ"):
+    return [{
+        "type": "Pods",
+        "pods": {
+            "metric": {"name": name},
+            "target": {"type": "AverageValue", "averageValue": value},
+        },
+    }]
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(clock, *, target=0.5, tol=0.1, down=5.0, up=0.0,
+         lo=1, hi=4, name="occ"):
+    return Autoscaler(
+        AutoscalerConfig(
+            min_replicas=lo, max_replicas=hi, targets={name: target},
+            tolerance=tol, scale_up_stabilization_s=up,
+            scale_down_stabilization_s=down,
+        ),
+        clock=clock,
+    )
+
+
+# -- config / parsing --------------------------------------------------------
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(targets={}).validate()
+    with pytest.raises(ValueError):
+        AutoscalerConfig(targets={"m": 0}).validate()
+
+
+def test_signal_values_parses_hpa_entries():
+    entries = sig(0.8) + [
+        {"type": "Resource", "resource": {}},           # not a Pods entry
+        {"type": "Pods", "pods": {"metric": {"name": "bad"},
+                                  "target": {"type": "Utilization"}}},
+        {"type": "Pods", "pods": {"metric": {"name": "nan"},
+                                  "target": {"type": "AverageValue",
+                                             "averageValue": "x"}}},
+    ]
+    assert signal_values(entries) == {"occ": 0.8}
+    assert signal_values([]) == {}
+    assert signal_values(None) == {}
+
+
+# -- golden decision table ---------------------------------------------------
+def test_golden_scale_up_is_immediate():
+    clk = Clock()
+    a = make(clk)
+    # value 1.0 vs target 0.5 at current=2: ceil(2*2.0) = 4
+    d = a.evaluate(sig(1.0), 2)
+    assert (d.desired, d.recommendation) == (4, 4)
+    # value 0.6 vs target 0.5 at current=1: ratio 1.2 outside the 10%
+    # band -> ceil(1*1.2) = 2
+    d = make(Clock()).evaluate(sig(0.6), 1)
+    assert d.desired == 2
+
+
+def test_golden_tolerance_band_holds():
+    # |ratio - 1| <= 0.1 votes for the current count
+    for value in (0.45, 0.5, 0.55):
+        d = make(Clock()).evaluate(sig(value), 3)
+        assert d.desired == 3, f"value={value} must hold at 3"
+
+
+def test_golden_clamps():
+    # ratio 10 at current=2 wants 20; max_replicas clamps to 4
+    assert make(Clock()).evaluate(sig(5.0), 2).desired == 4
+    # ratio ~0 wants 1 but min_replicas=2 clamps (window observed)
+    clk = Clock()
+    a = make(clk, lo=2, down=1.0)
+    a.evaluate(sig(0.01), 3)
+    clk.t = 2.0
+    assert a.evaluate(sig(0.01), 3).desired == 2
+
+
+def test_golden_no_signals_holds_steady():
+    d = make(Clock()).evaluate([], 3)
+    assert d.desired == 3
+    assert d.reason == "no signals"
+
+
+def test_golden_scale_down_waits_for_observed_window():
+    clk = Clock()
+    a = make(clk, down=5.0)
+    # t=0: quiet sample, but the 5s window predates history -> HOLD
+    assert a.evaluate(sig(0.0), 2).desired == 2
+    # t=3: still inside the unobserved window -> HOLD
+    clk.t = 3.0
+    assert a.evaluate(sig(0.0), 2).desired == 2
+    # t=5.5: a full 5s of low recommendations observed -> scale down
+    clk.t = 5.5
+    assert a.evaluate(sig(0.0), 2).desired == 1
+
+
+def test_golden_spike_pins_scale_down_until_window_clears():
+    clk = Clock()
+    a = make(clk, down=5.0)
+    a.evaluate(sig(0.0), 2)            # t=0   rec 1
+    clk.t = 2.0
+    d = a.evaluate(sig(2.0), 2)        # t=2   spike: rec 4, scale up
+    assert d.desired == 4
+    clk.t = 4.0
+    # quiet again, but the t=2 spike is inside [−1, 4] -> hold at 4
+    assert a.evaluate(sig(0.0), 4).desired == 4
+    clk.t = 6.9
+    # spike rec stood until t=4 (recommendations hold until the next
+    # sample), so window [1.9, 6.9] still saw it -> hold
+    assert a.evaluate(sig(0.0), 4).desired == 4
+    clk.t = 9.1
+    # window [4.1, 9.1]: standing rec at window start is t=4's quiet 1
+    # and everything after is quiet -> scale down
+    assert a.evaluate(sig(0.0), 4).desired == 1
+
+
+def test_golden_scale_up_stabilization_takes_window_min():
+    clk = Clock()
+    a = make(clk, up=3.0, down=10.0)
+    a.evaluate(sig(0.5), 2)            # t=0 rec 2 (in band)
+    clk.t = 1.0
+    # raw rec 4, but min over the up-window {2 (standing), 4} = 2
+    d = a.evaluate(sig(1.0), 2)
+    assert (d.recommendation, d.desired) == (4, 2)
+    clk.t = 4.0
+    # window [1, 4] now only holds high recs -> up goes through
+    d = a.evaluate(sig(1.0), 2)
+    assert d.desired == 4
+
+
+def test_golden_multiple_metrics_most_pressured_wins():
+    clk = Clock()
+    a = Autoscaler(
+        AutoscalerConfig(
+            min_replicas=1, max_replicas=8,
+            targets={"occ": 0.5, "queue": 2.0},
+            scale_down_stabilization_s=5.0,
+        ),
+        clock=clk,
+    )
+    signals = sig(0.5, "occ") + sig(8.0, "queue")
+    # occ votes hold (ratio 1); queue ratio 4 at current=2 votes 8
+    d = a.evaluate(signals, 2)
+    assert d.desired == 8
+    assert "queue" in d.reason
+
+
+# -- the closed loop (fakes: no sockets) ------------------------------------
+class FakeFleet:
+    def __init__(self):
+        self.desired = 2
+        self.scale_calls = []
+        self._targets = {"replica-0": "http://x:1", "replica-1": "http://x:2"}
+
+    def targets(self):
+        return dict(self._targets)
+
+    def scale_to(self, n, reason=""):
+        self.scale_calls.append((n, reason))
+        self.desired = n
+
+
+class FakeCollector:
+    def __init__(self, signals):
+        self.signals = signals
+        self.refreshed = []
+
+    def refresh(self, targets):
+        self.refreshed.append(list(targets))
+
+    def hpa_signals(self):
+        return self.signals
+
+
+def test_loop_tick_refreshes_targets_and_applies_decision():
+    fleet = FakeFleet()
+    coll = FakeCollector(sig(2.0))  # heavy pressure
+    loop = AutoscaleLoop(fleet, coll, AutoscalerConfig(
+        min_replicas=1, max_replicas=6, targets={"occ": 0.5},
+        scale_down_stabilization_s=5.0))
+    decision = loop.tick()
+    # the collector was re-pointed at the fleet's current replica set
+    assert coll.refreshed == [sorted(fleet.targets().items())]
+    # ceil(2 * 4.0) = 8, clamped to 6, applied through scale_to
+    assert decision.desired == 6
+    assert fleet.scale_calls == [(6, decision.reason)]
+    assert loop.decisions[-1] is decision
+
+
+def test_loop_tick_no_change_means_no_scale_call():
+    fleet = FakeFleet()
+    coll = FakeCollector(sig(0.5))  # exactly on target
+    loop = AutoscaleLoop(fleet, coll, AutoscalerConfig(
+        min_replicas=1, max_replicas=6, targets={"occ": 0.5}))
+    loop.tick()
+    assert fleet.scale_calls == []

@@ -1,0 +1,215 @@
+"""Loadgen tests: trace determinism, workload shapes, outcome accounting.
+
+The determinism test is the replay contract: ``trace_json`` must be
+byte-stable for a given spec, because chaos runs are bisected by
+replaying the exact same traffic.
+"""
+
+import threading
+
+import pytest
+
+from devspace_tpu.serving import (
+    LoadGenerator,
+    ReplicaFleet,
+    ReplicaSpec,
+    TraceSpec,
+    generate_trace,
+)
+from devspace_tpu.serving.loadgen import OUTCOMES, LoadReport, RequestOutcome, trace_json
+from devspace_tpu.serving.stub import token_at
+
+
+# -- determinism -------------------------------------------------------------
+@pytest.mark.parametrize("kind", ["poisson", "chat", "bursty"])
+def test_trace_byte_stable_per_seed(kind):
+    spec = TraceSpec(kind=kind, seed=42, duration_s=2.0, rate_rps=10)
+    again = TraceSpec(kind=kind, seed=42, duration_s=2.0, rate_rps=10)
+    assert trace_json(spec) == trace_json(again)
+    # a different seed must actually change the trace
+    assert trace_json(spec) != trace_json(
+        TraceSpec(kind=kind, seed=43, duration_s=2.0, rate_rps=10)
+    )
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown trace kind"):
+        generate_trace(TraceSpec(kind="sawtooth"))
+
+
+# -- workload shapes ---------------------------------------------------------
+def test_poisson_trace_sorted_and_bounded():
+    spec = TraceSpec(kind="poisson", seed=1, duration_s=3.0, rate_rps=20)
+    trace = generate_trace(spec)
+    assert trace, "a 3s/20rps trace must produce events"
+    ats = [e["at"] for e in trace]
+    assert ats == sorted(ats)
+    assert all(0 <= t < spec.duration_s for t in ats)
+    lo, hi = spec.prompt_len
+    assert all(lo <= len(e["prompt_ids"]) <= hi for e in trace)
+    assert {e["sampled"] for e in trace} == {True, False}
+
+
+def test_chat_sessions_share_growing_prefix():
+    trace = generate_trace(
+        TraceSpec(kind="chat", seed=3, duration_s=2.0, rate_rps=5,
+                  turns=(3, 3))
+    )
+    sessions = {}
+    for e in trace:
+        sessions.setdefault(e["session"], []).append(e)
+    multi = [v for v in sessions.values() if len(v) > 1]
+    assert multi, "chat trace must contain multi-turn sessions"
+    for turns in multi:
+        turns.sort(key=lambda e: e["at"])
+        for prev, nxt in zip(turns, turns[1:]):
+            prev_prompt = prev["prompt_ids"]
+            # next turn = previous prompt + previous turn's full reply
+            reply = [token_at(prev_prompt, i)
+                     for i in range(prev["max_new_tokens"])]
+            assert nxt["prompt_ids"] == prev_prompt + reply
+
+
+def test_bursty_trace_denser_in_bursts():
+    spec = TraceSpec(kind="bursty", seed=9, duration_s=8.0, rate_rps=10,
+                     burst_on_s=1.0, burst_off_s=1.0, burst_multiplier=4.0)
+    trace = generate_trace(spec)
+    period = spec.burst_on_s + spec.burst_off_s
+    on = sum(1 for e in trace if (e["at"] % period) < spec.burst_on_s)
+    off = len(trace) - on
+    assert on > 2 * off, f"burst phase must dominate: on={on} off={off}"
+
+
+# -- report accounting -------------------------------------------------------
+def test_report_counts_and_quantiles():
+    rep = LoadReport(outcomes=[
+        RequestOutcome(id=0, outcome="completed", latency_s=0.1),
+        RequestOutcome(id=1, outcome="completed", latency_s=0.3),
+        RequestOutcome(id=2, outcome="retried", latency_s=0.5, attempts=2),
+        RequestOutcome(id=3, outcome="failed", latency_s=9.0),
+    ], wall_s=1.0)
+    counts = rep.counts()
+    assert set(counts) == set(OUTCOMES)
+    assert counts["completed"] == 2 and counts["retried"] == 1
+    assert sum(counts.values()) == 4
+    # failed latencies are excluded from the served-latency quantiles
+    assert rep.latency_quantile(1.0) == 0.5
+    d = rep.to_dict()
+    assert d["requests"] == 4 and d["counts"]["failed"] == 1
+
+
+def test_no_targets_resolves_as_failed():
+    gen = LoadGenerator(lambda: {}, max_attempts=2, hang_timeout_s=2)
+    trace = generate_trace(
+        TraceSpec(seed=0, duration_s=0.2, rate_rps=20))
+    report = gen.run(trace, speed=10.0)
+    assert len(report.outcomes) == len(trace)
+    assert report.counts()["failed"] == len(trace)
+
+
+# -- live replay against a stub replica -------------------------------------
+def test_replay_verifies_streams_live():
+    fleet = ReplicaFleet(
+        spec=ReplicaSpec(env={"STUB_TOKEN_DELAY_S": "0.001"}),
+        replicas=1, poll_interval=0.1)
+    fleet.start()
+    try:
+        trace = generate_trace(
+            TraceSpec(seed=7, duration_s=0.6, rate_rps=25))
+        gen = LoadGenerator(fleet.targets, request_timeout_s=5,
+                            hang_timeout_s=10)
+        report = gen.run(trace, speed=2.0)
+        counts = report.counts()
+        assert len(report.outcomes) == len(trace)
+        assert counts["completed"] == len(trace), counts
+        assert counts["corrupted"] == 0 and counts["hung"] == 0
+        assert all(o.tokens == trace[i]["max_new_tokens"]
+                   for i, o in enumerate(report.outcomes))
+    finally:
+        fleet.stop()
+
+
+def test_corruption_is_detected_not_papered_over():
+    # a target that streams WRONG tokens must yield outcome=corrupted:
+    # the verifier compares against token_at, so a lying replica can't
+    # hide behind a well-formed stream
+    import http.server
+    import json as _json
+    import threading as _threading
+
+    class LyingHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.end_headers()
+            for tok in (1, 2, 3):
+                self.wfile.write(
+                    _json.dumps({"token": tok}).encode() + b"\n")
+            self.wfile.write(_json.dumps({"done": True}).encode() + b"\n")
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), LyingHandler)
+    th = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        gen = LoadGenerator(lambda: {"liar": url}, hang_timeout_s=5)
+        trace = generate_trace(TraceSpec(seed=2, duration_s=0.2, rate_rps=10))
+        report = gen.run(trace, speed=10.0)
+        assert report.counts()["corrupted"] == len(trace)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_truncated_stream_is_death_not_corruption():
+    # a replica killed mid-stream surfaces as EOF (close-delimited body)
+    # or a half-written line, never as a socket error — the verifier must
+    # classify a correct-prefix truncation as a death (retryable), and
+    # reserve `corrupted` for wrong content. With every target
+    # truncating, requests end `failed`; corrupted stays zero.
+    import http.server
+    import json as _json
+    import threading as _threading
+
+    from devspace_tpu.serving.stub import token_at
+
+    class TruncatingHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+        def do_POST(self):  # noqa: N802
+            body = _json.loads(
+                self.rfile.read(int(self.headers.get("Content-Length", 0))))
+            self.send_response(200)
+            self.end_headers()
+            # two CORRECT tokens, then a half-written third line and a
+            # dropped connection — no done marker ever arrives
+            for i in range(2):
+                self.wfile.write(_json.dumps(
+                    {"token": token_at(body["prompt_ids"], i)}
+                ).encode() + b"\n")
+            self.wfile.write(b'{"tok')
+            self.wfile.flush()
+            self.connection.close()
+
+    httpd = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), TruncatingHandler)
+    th = _threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        gen = LoadGenerator(lambda: {"trunc": url}, hang_timeout_s=5)
+        trace = generate_trace(TraceSpec(
+            seed=3, duration_s=0.2, rate_rps=10,
+            max_new_tokens=(4, 8)))
+        report = gen.run(trace, speed=10.0)
+        counts = report.counts()
+        assert counts["corrupted"] == 0, counts
+        assert counts["hung"] == 0, counts
+        assert counts["failed"] == len(trace), counts
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
