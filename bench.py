@@ -1186,6 +1186,123 @@ def bench_fleet_recovery() -> float:
         fleet.stop()
 
 
+def bench_router() -> dict:
+    """Prefix-aware routing A/B (ISSUE 19): the same shared-prefix chat
+    trace replayed open-loop through the routing gateway over a fresh
+    3-replica stub fleet, once with ``--route prefix`` and once with
+    ``--route round_robin``. The stub replicas charge a simulated
+    prefill cost per *uncached* prompt token and keep a real radix
+    prefix memory, so cache locality is physically visible: prefix
+    routing must beat round-robin on aggregate tok/s, p50/p99 TTFT, and
+    land >=1.2x the cache-hit tokens per request. Host-side
+    subprocesses only; the regression guard for the ``router_*`` keys."""
+    import urllib.request
+
+    from devspace_tpu.obs.collector import TelemetryCollector
+    from devspace_tpu.serving import (
+        LoadGenerator,
+        ReplicaFleet,
+        ReplicaSpec,
+        TraceSpec,
+        generate_trace,
+    )
+    from devspace_tpu.serving.gateway import RoutingGateway
+    from devspace_tpu.serving.router import (
+        PrefixRouter,
+        RouterConfig,
+        loads_from_collector,
+    )
+    from devspace_tpu.utils.log import StdoutLogger
+
+    trace = generate_trace(TraceSpec(
+        seed=19, kind="chat", duration_s=3.0, rate_rps=30,
+        prompt_len=(24, 48), max_new_tokens=(8, 16), turns=(3, 5),
+        think_time_s=(0.05, 0.2)))
+
+    def run_arm(policy: str) -> dict:
+        # fresh fleet per arm: both policies start with cold caches
+        fleet = ReplicaFleet(
+            spec=ReplicaSpec(env={
+                "STUB_TOKEN_DELAY_S": "0.002",
+                # 0.004s/uncached prompt token ~= a real prefill bill:
+                # a cold 48-token turn-3 prompt costs ~0.2s, a routed
+                # cache hit skips most of it
+                "STUB_PREFILL_DELAY_PER_TOKEN_S": "0.004",
+                "STUB_MAX_SLOTS": "8",
+            }),
+            replicas=3, poll_interval=0.1,
+            logger=StdoutLogger(stream=sys.stderr),
+        )
+        fleet.start()
+        gw = coll = None
+        try:
+            # live load signals exactly as `fleet serve --route` wires
+            # them: collector snapshots blended with the router's own
+            # in-flight counts
+            coll = TelemetryCollector.from_replicas([], interval_s=0.2)
+            coll.refresh(sorted(fleet.targets().items()))
+            coll.scrape_once()
+            coll.start()
+            router = PrefixRouter(
+                replicas_fn=fleet.targets,
+                loads_fn=lambda: loads_from_collector(coll),
+                # admission off: both arms must accept identical traffic
+                # for the A/B to compare routing policy alone
+                config=RouterConfig(policy=policy, admission=False))
+            gw = RoutingGateway(router, port=0)
+            gw.start()
+            gen = LoadGenerator(
+                lambda: {"gw": gw.base_url},
+                request_timeout_s=30, hang_timeout_s=60, max_attempts=3)
+            report = gen.run(trace)
+            counts = report.counts()
+            bad = counts["corrupted"] + counts["hung"] + counts["failed"]
+            if bad:
+                raise RuntimeError(
+                    f"router bench arm {policy} lost streams: {counts}")
+            hit_tokens = 0.0
+            for url in fleet.targets().values():
+                with urllib.request.urlopen(
+                        url + "/metrics", timeout=5) as resp:
+                    for line in resp.read().decode().splitlines():
+                        if line.startswith(
+                                "engine_prefix_hit_tokens_total "):
+                            hit_tokens += float(line.split()[1])
+            return {
+                "tok_per_sec": report.total_tokens() / report.wall_s,
+                "p50_ttft_ms": report.ttft_quantile(0.50) * 1000,
+                "p99_ttft_ms": report.ttft_quantile(0.99) * 1000,
+                "hit_tokens_per_request": hit_tokens / len(trace),
+            }
+        finally:
+            if gw is not None:
+                gw.stop()
+            if coll is not None:
+                coll.stop()
+            fleet.stop()
+
+    prefix = run_arm("prefix")
+    rr = run_arm("round_robin")
+    return {
+        "router_requests": len(trace),
+        "router_prefix_tok_per_sec": round(prefix["tok_per_sec"], 1),
+        "router_round_robin_tok_per_sec": round(rr["tok_per_sec"], 1),
+        "router_speedup": round(
+            prefix["tok_per_sec"] / rr["tok_per_sec"], 3),
+        "router_prefix_p50_ttft_ms": round(prefix["p50_ttft_ms"], 1),
+        "router_prefix_p99_ttft_ms": round(prefix["p99_ttft_ms"], 1),
+        "router_round_robin_p50_ttft_ms": round(rr["p50_ttft_ms"], 1),
+        "router_round_robin_p99_ttft_ms": round(rr["p99_ttft_ms"], 1),
+        "router_hit_tokens_per_request": round(
+            prefix["hit_tokens_per_request"], 1),
+        "router_round_robin_hit_tokens_per_request": round(
+            rr["hit_tokens_per_request"], 1),
+        "router_hit_tokens_ratio": round(
+            prefix["hit_tokens_per_request"]
+            / max(1e-9, rr["hit_tokens_per_request"]), 2),
+    }
+
+
 def main() -> int:
     if os.environ.get("DEVSPACE_BENCH_WEDGE_CHILD") and (
         "--resnet-child" in sys.argv
@@ -1269,6 +1386,41 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             notes.append(f"fleet recovery bench failed: {e}")
             log(f"[bench] fleet recovery bench failed: {e}")
+    # prefix-aware routing A/B (ISSUE 19): shared-prefix chat trace
+    # through the gateway, prefix vs round_robin on fresh stub fleets —
+    # real subprocesses and ~30s of wall, so it yields to the budget
+    router_ab = None
+    if remaining_budget() < 90.0:
+        notes.append("router bench skipped (budget exhausted)")
+        log(f"[bench] router bench skipped — {remaining_budget():.0f}s left")
+    else:
+        try:
+            router_ab = bench_router()
+            log(
+                "[bench] router A/B (chat trace, 3 replicas): prefix "
+                f"{router_ab['router_prefix_tok_per_sec']} tok/s "
+                f"p99 TTFT {router_ab['router_prefix_p99_ttft_ms']}ms vs "
+                f"round-robin {router_ab['router_round_robin_tok_per_sec']} "
+                f"tok/s p99 {router_ab['router_round_robin_p99_ttft_ms']}ms; "
+                f"hit tokens/request {router_ab['router_hit_tokens_per_request']} "
+                f"({router_ab['router_hit_tokens_ratio']}x round-robin)"
+            )
+            if router_ab["router_speedup"] <= 1.0:
+                notes.append(
+                    "router bench: prefix routing did not beat "
+                    f"round-robin tok/s ({router_ab['router_speedup']}x)")
+            if (router_ab["router_prefix_p99_ttft_ms"]
+                    >= router_ab["router_round_robin_p99_ttft_ms"]):
+                notes.append(
+                    "router bench: prefix routing did not beat "
+                    "round-robin p99 TTFT")
+            if router_ab["router_hit_tokens_ratio"] < 1.2:
+                notes.append(
+                    "router bench: cache-hit tokens per request below "
+                    f"the 1.2x bar ({router_ab['router_hit_tokens_ratio']}x)")
+        except Exception as e:  # noqa: BLE001
+            notes.append(f"router bench failed: {e}")
+            log(f"[bench] router bench failed: {e}")
     sync_latency = None
     try:
         sync_latency = bench_sync_latency()
@@ -1452,6 +1604,8 @@ def main() -> int:
         "collector_scrape_ms": collector_scrape_ms,
         # replica SIGKILL -> fleet all-healthy (3-replica CPU stub fleet)
         "fleet_recovery_ms": fleet_recovery_ms,
+        # prefix-aware routing A/B over the gateway (ISSUE 19)
+        **(router_ab or {}),
     }
     hb(f"bench done (status={status})")
     print(json.dumps(result))

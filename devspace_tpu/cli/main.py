@@ -1228,15 +1228,33 @@ def cmd_fleet(args) -> int:
                 ) if d.desired != d.current else None
             ),
         )
+    gateway = None
+    if getattr(args, "route", None):
+        from ..serving.gateway import RoutingGateway
+        from ..serving.router import (
+            PrefixRouter,
+            RouterConfig,
+            loads_from_collector,
+        )
+
+        router = PrefixRouter(
+            replicas_fn=fleet.targets,
+            loads_fn=lambda: loads_from_collector(collector),
+            config=RouterConfig(policy=args.route),
+        )
+        gateway = RoutingGateway(
+            router, host=args.host, port=args.gateway_port)
+        gateway.start()
     collector.start()
     if loop is not None:
         loop.start()
     log.done(
         "fleet of %d replica(s) up (module %s); collector on "
-        "http://%s:%d%s",
+        "http://%s:%d%s%s",
         args.replicas, args.module, args.host, httpd.server_address[1],
         f"; autoscaling {args.min_replicas}-{args.max_replicas} on "
         f"{args.metric}<={args.target_value:g}" if args.autoscale else "",
+        f"; {args.route} gateway on {gateway.base_url}" if gateway else "",
     )
     import threading
 
@@ -1254,6 +1272,8 @@ def cmd_fleet(args) -> int:
     finally:
         if loop is not None:
             loop.stop()
+        if gateway is not None:
+            gateway.stop()
         collector.stop()
         httpd.shutdown()
         httpd.server_close()
@@ -2535,6 +2555,20 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=0,
         help="run N seconds then exit (0 = run until Ctrl-C)",
+    )
+    q.add_argument(
+        "--route",
+        choices=("prefix", "round_robin", "least_loaded"),
+        default=None,
+        help="front the fleet with a routing gateway using this policy "
+        "(prefix = cache-locality scoring blended with load; omit for "
+        "no gateway)",
+    )
+    q.add_argument(
+        "--gateway-port",
+        type=int,
+        default=8080,
+        help="routing gateway port (with --route; 0 picks a free port)",
     )
     q.set_defaults(fn=cmd_fleet)
     q = fleet_sub.add_parser(

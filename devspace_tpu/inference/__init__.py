@@ -1,4 +1,35 @@
-from .checkpoint import load_serving_params  # noqa: F401
-from .dispatch import DecodeDispatcher, resolve_dispatch_depth  # noqa: F401
-from .engine import InferenceEngine, Request  # noqa: F401
-from .speculative import SpecStats, generate_speculative  # noqa: F401
+"""Inference package: paged-KV engine, dispatcher, prefix cache, tiers.
+
+Exports resolve lazily (PEP 562): importing a pure-host submodule such
+as :mod:`.prefix_cache` must not drag jax in — the serving stub replica
+and the routing gateway import the prefix fingerprint helper at process
+start, and a fleet of them would otherwise pay a jax import each.
+``from devspace_tpu.inference import InferenceEngine`` still works
+unchanged; the engine module loads on first attribute access.
+"""
+
+_EXPORTS = {
+    "load_serving_params": ".checkpoint",
+    "DecodeDispatcher": ".dispatch",
+    "resolve_dispatch_depth": ".dispatch",
+    "InferenceEngine": ".engine",
+    "Request": ".engine",
+    "SpecStats": ".speculative",
+    "generate_speculative": ".speculative",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    return getattr(import_module(mod, __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

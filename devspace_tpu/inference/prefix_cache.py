@@ -78,6 +78,27 @@ def _chain_digest(parent_digest: str, edge: tuple) -> str:
     return h.hexdigest()
 
 
+def fingerprint_chain(token_ids, block_size: int) -> list:
+    """Block-digest chain of a token prefix: the blake2b content
+    addresses of each complete ``block_size`` block, chained from the
+    root exactly as the radix tree computes them (``_chain_digest`` with
+    the root anchor ``""``). Two prefixes share their first K digests
+    iff they share their first ``K * block_size`` tokens — which is what
+    lets a component that never sees another process's radix tree (the
+    serving router's shadow index, the stub replica's prefix memory)
+    still reason about cache overlap in the tree's own currency. The
+    trailing partial block is excluded: it can never be a published
+    cache entry. O(len(token_ids)) hashing."""
+    if block_size < 1:
+        raise ValueError("block_size must be >= 1")
+    digest = ""
+    chain = []
+    for i in range(0, len(token_ids) - block_size + 1, block_size):
+        digest = _chain_digest(digest, tuple(token_ids[i:i + block_size]))
+        chain.append(digest)
+    return chain
+
+
 class _Node:
     """One published block: ``edge`` is the block's own token tuple (the
     child key under ``parent``), ``blk`` the pool block id, ``refs`` the

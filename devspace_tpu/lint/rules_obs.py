@@ -59,6 +59,7 @@ def load_metric_catalogs() -> dict:
     from devspace_tpu.obs.tracing import TRACING_METRIC_FAMILIES
     from devspace_tpu.resilience.policy import RESILIENCE_METRIC_FAMILIES
     from devspace_tpu.serving.fleet import FLEET_METRIC_FAMILIES
+    from devspace_tpu.serving.router import SERVING_ROUTER_METRIC_FAMILIES
     from devspace_tpu.sync.session import SYNC_METRIC_FAMILIES
     from devspace_tpu.utils.trace import TRACE_METRIC_FAMILIES
 
@@ -73,6 +74,7 @@ def load_metric_catalogs() -> dict:
         "slo": SLO_METRIC_FAMILIES,
         "collector": COLLECTOR_METRIC_FAMILIES,
         "fleet": FLEET_METRIC_FAMILIES,
+        "router": SERVING_ROUTER_METRIC_FAMILIES,
     }
 
 

@@ -56,6 +56,7 @@ EVENT_SUBSYSTEMS = (
     "fleet",
     "kv_tier",
     "resilience",
+    "router",
     "serving",
     "slo",
     "supervisor",
@@ -86,6 +87,10 @@ EVENT_CATALOG = (
     ("resilience", "circuit_open", "Circuit breaker opened after repeated failures"),
     ("resilience", "circuit_close", "Circuit breaker closed after a probe success"),
     ("resilience", "retries_exhausted", "Retry policy gave up after max attempts"),
+    ("router", "request_routed", "Gateway routed a request to a replica"),
+    ("router", "spillover", "Request steered off its best prefix holder (it was hot)"),
+    ("router", "request_rejected", "SLO-aware admission shed a request (breach band)"),
+    ("router", "retry_rerouted", "Request rerouted after its replica failed before first byte"),
     ("serving", "drain_started", "Serving process entered drain mode (readyz 503, healthz live)"),
     ("serving", "drain_cleared", "Serving process left drain mode and readmits traffic"),
     ("slo", "warn", "SLO burn rate crossed the warn threshold"),

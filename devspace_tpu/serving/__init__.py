@@ -6,8 +6,9 @@ replica fleet manager restarting and draining serve processes under the
 session supervisor (:mod:`.fleet`), a closed-loop autoscaler driving
 replica count from collector HPA signals (:mod:`.autoscale`), an
 open-loop traffic generator with per-request outcome accounting
-(:mod:`.loadgen`), and a deterministic stub replica that makes all of
-it testable in milliseconds (:mod:`.stub`).
+(:mod:`.loadgen`), a deterministic stub replica that makes all of it
+testable in milliseconds (:mod:`.stub`), and a prefix-cache-aware
+routing gateway fronting the fleet (:mod:`.router` + :mod:`.gateway`).
 """
 
 from .autoscale import (  # noqa: F401
@@ -26,10 +27,21 @@ from .fleet import (  # noqa: F401
     free_port,
     spawn_replica,
 )
+from .gateway import RoutingGateway  # noqa: F401
 from .loadgen import (  # noqa: F401
     LoadGenerator,
     LoadReport,
     RequestOutcome,
     TraceSpec,
     generate_trace,
+)
+from .router import (  # noqa: F401
+    ROUTE_POLICIES,
+    SERVING_ROUTER_METRIC_FAMILIES,
+    PrefixRouter,
+    ReplicaLoad,
+    RouterConfig,
+    RoutingDecision,
+    ShadowRadixIndex,
+    loads_from_collector,
 )

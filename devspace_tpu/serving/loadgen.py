@@ -8,9 +8,13 @@ Two halves, deliberately separable:
   can be replayed exactly and a regression bisected against the same
   traffic. Supported shapes: ``poisson`` (memoryless arrivals — the
   classic open-loop model), ``chat`` (multi-turn sessions whose turns
-  share a growing prefix — the prefix-cache-friendly pattern), and
-  ``bursty`` (on/off square wave — what forces scale-up then drain).
-  A ``sampled`` bit marks the greedy/sampled mix.
+  share a growing prefix — the prefix-cache-friendly pattern),
+  ``bursty`` (on/off square wave — what forces scale-up then drain),
+  and ``rag`` (a few very long shared contexts, each queried repeatedly
+  with a short question appended, interleaved with short chat — the
+  long-prompt/short-chat mix that makes prefix-aware routing or its
+  absence most expensive). A ``sampled`` bit marks the greedy/sampled
+  mix.
 
 - :class:`LoadGenerator` replays a trace **open-loop**: requests launch
   at their scheduled arrival time whether or not earlier ones finished
@@ -57,7 +61,7 @@ class TraceSpec:
     ``seed`` through one ``random.Random`` — the determinism contract
     :func:`trace_json` pins."""
 
-    kind: str = "poisson"  # poisson | chat | bursty
+    kind: str = "poisson"  # poisson | chat | bursty | rag
     seed: int = 0
     duration_s: float = 5.0
     rate_rps: float = 8.0
@@ -72,6 +76,12 @@ class TraceSpec:
     burst_on_s: float = 1.0
     burst_off_s: float = 1.0
     burst_multiplier: float = 4.0
+    # rag: rag_contexts shared long documents; a rag_long_fraction of
+    # arrivals are a context + short question (session = context id),
+    # the rest ordinary short chat prompts (session = -1)
+    rag_contexts: int = 3
+    rag_context_len: tuple = (192, 384)
+    rag_long_fraction: float = 0.3
 
 
 def _round(x: float) -> float:
@@ -133,6 +143,23 @@ def generate_trace(spec: TraceSpec) -> list:
                 turn_at = _round(
                     turn_at + rng.uniform(*spec.think_time_s))
             session += 1
+    elif spec.kind == "rag":
+        contexts = [prompt(rng.randint(*spec.rag_context_len))
+                    for _ in range(max(1, spec.rag_contexts))]
+        t = 0.0
+        while True:
+            t += rng.expovariate(spec.rate_rps)
+            if t >= spec.duration_s:
+                break
+            if rng.random() < spec.rag_long_fraction:
+                # long RAG query: shared context + fresh short question
+                ctx = rng.randrange(len(contexts))
+                ids = contexts[ctx] + prompt(
+                    rng.randint(*spec.prompt_len))
+                events.append(one(t, ids, ctx))
+            else:
+                events.append(
+                    one(t, prompt(rng.randint(*spec.prompt_len)), -1))
     else:
         raise ValueError(f"unknown trace kind {spec.kind!r}")
 
@@ -155,6 +182,7 @@ class RequestOutcome:
     latency_s: float
     attempts: int = 1
     tokens: int = 0
+    ttft_s: float = 0.0   # request start -> first verified token
     error: str = ""
 
 
@@ -176,6 +204,20 @@ class LoadReport:
             return 0.0
         return lat[min(len(lat) - 1, int(q * len(lat)))]
 
+    def ttft_quantile(self, q: float) -> float:
+        """Quantile of time-to-first-verified-token across successful
+        requests — the serving-tier SLI the router optimises."""
+        lat = sorted(o.ttft_s for o in self.outcomes
+                     if o.outcome in ("completed", "retried")
+                     and o.ttft_s > 0)
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+    def total_tokens(self) -> int:
+        return sum(o.tokens for o in self.outcomes
+                   if o.outcome in ("completed", "retried"))
+
     def to_dict(self) -> dict:
         return {
             "requests": len(self.outcomes),
@@ -183,6 +225,9 @@ class LoadReport:
             "counts": self.counts(),
             "p50_latency_s": round(self.latency_quantile(0.50), 4),
             "p95_latency_s": round(self.latency_quantile(0.95), 4),
+            "p50_ttft_s": round(self.ttft_quantile(0.50), 4),
+            "p99_ttft_s": round(self.ttft_quantile(0.99), 4),
+            "tokens": self.total_tokens(),
         }
 
 
@@ -230,9 +275,10 @@ class LoadGenerator:
             self.seed * 1_000_003 + request_id * 1_009 + attempt)
         return rng.choice(urls)
 
-    def _stream_once(self, url: str, event: dict, deadline: float) -> int:
-        """One streaming attempt, verified token-for-token. Returns the
-        token count; raises _StreamDied / _StreamCorrupt /
+    def _stream_once(self, url: str, event: dict,
+                     deadline: float) -> tuple:
+        """One streaming attempt, verified token-for-token. Returns
+        ``(token_count, ttft_s)``; raises _StreamDied / _StreamCorrupt /
         socket.timeout."""
         prompt = event["prompt_ids"]
         n = event["max_new_tokens"]
@@ -250,6 +296,8 @@ class LoadGenerator:
                       max(0.1, deadline - time.monotonic()))
         got: list = []
         done = False
+        t_start = time.monotonic()
+        ttft = 0.0
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 for raw in resp:
@@ -273,6 +321,8 @@ class LoadGenerator:
                         break
                     if "token" not in msg:
                         raise _StreamCorrupt(f"line without token: {msg}")
+                    if not got:
+                        ttft = time.monotonic() - t_start
                     got.append(msg["token"])
         except (urllib.error.URLError, ConnectionError, socket.timeout,
                 http.client.IncompleteRead,
@@ -298,7 +348,7 @@ class LoadGenerator:
             # end-of-stream, not as a socket error) — retryable
             raise _StreamDied(
                 f"stream truncated at {len(got)}/{len(expected)} tokens")
-        return len(got)
+        return len(got), ttft
 
     def _run_one(self, event: dict) -> RequestOutcome:
         t0 = time.monotonic()
@@ -313,12 +363,15 @@ class LoadGenerator:
                 continue
             last_url = url
             try:
-                tokens = self._stream_once(url, event, deadline)
+                t_att = time.monotonic()
+                tokens, ttft = self._stream_once(url, event, deadline)
                 return RequestOutcome(
                     id=event["id"],
                     outcome="completed" if attempt == 1 else "retried",
                     latency_s=time.monotonic() - t0,
                     attempts=attempt, tokens=tokens,
+                    # from request start, so retry overhead counts
+                    ttft_s=(t_att - t0) + ttft,
                 )
             except _StreamCorrupt as e:
                 return RequestOutcome(

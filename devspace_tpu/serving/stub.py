@@ -26,7 +26,18 @@ Chaos is first-class: ``POST /chaos`` flips failure modes at runtime —
 Env knobs: ``PORT``, ``STUB_MAX_SLOTS`` (admission concurrency, default
 4), ``STUB_TOKEN_DELAY_S`` (per-token sleep, default 0.02 — requests
 may override with a ``token_delay_s`` field), ``STUB_STARTUP_DELAY_S``
-(sleep before binding, for ready-timeout tests).
+(sleep before binding, for ready-timeout tests),
+``STUB_PREFILL_DELAY_PER_TOKEN_S`` (simulated prefill cost per
+*uncached* prompt token, default 0 — set it to make prefix-cache
+locality physically observable in TTFT), ``STUB_PREFIX_BLOCK``
+(fingerprint block size, default 8 — must match the router's
+``block_size`` for the shadow index to mirror reality).
+
+The stub keeps a real radix-shaped prefix memory (the same blake2b
+block-digest chains as :mod:`devspace_tpu.inference.prefix_cache`) and
+reports ``engine_prefix_hit_tokens_total`` through its callback
+metrics, so routing efficacy — cache-hit tokens per routed request —
+is observable end-to-end without a JAX engine.
 """
 
 from __future__ import annotations
@@ -36,8 +47,10 @@ import os
 import threading
 import time
 
+from ..inference.prefix_cache import fingerprint_chain
 from ..obs import events as obs_events
 from ..obs.metrics import Registry, WindowedRate
+from .router import ShadowRadixIndex
 
 VOCAB = 50_000
 
@@ -67,6 +80,14 @@ class StubState:
         self.metrics_garbage = False
         self.slots = threading.Semaphore(self.max_slots)
 
+        # radix-shaped prefix memory: same digest chains as the real
+        # cache, LRU-bounded, guarded by self.lock
+        self.prefix_block = max(
+            1, int(os.environ.get("STUB_PREFIX_BLOCK", 8)))
+        self.prefix = ShadowRadixIndex(
+            max_blocks=int(os.environ.get("STUB_PREFIX_MAX_BLOCKS", 4096)))
+        self.prefix_hit_tokens = 0
+
         self.registry = Registry()
         reg = self.registry
         self.m_completed = reg.counter(
@@ -90,6 +111,10 @@ class StubState:
             "engine_dispatch_depth_occupancy", "gauge",
             "Slot occupancy fraction",
             lambda: self.active / self.max_slots)
+        reg.register_callback(
+            "engine_prefix_hit_tokens_total", "counter",
+            "Prompt tokens served from the radix prefix cache",
+            lambda: self.prefix_hit_tokens)
         self.ttft = reg.histogram("ttft_seconds", "Time to first token")
         self.e2e = reg.histogram("request_e2e_seconds", "End-to-end latency")
 
@@ -109,6 +134,8 @@ def main(argv=None) -> int:
 
     state = StubState(max_slots=int(os.environ.get("STUB_MAX_SLOTS", 4)))
     default_delay = float(os.environ.get("STUB_TOKEN_DELAY_S", 0.02))
+    prefill_delay = float(
+        os.environ.get("STUB_PREFILL_DELAY_PER_TOKEN_S", 0))
     flight = obs_events.add_sink(obs_events.FlightRecorder(per_subsystem=128))
 
     class Handler(BaseHTTPRequestHandler):
@@ -219,6 +246,21 @@ def main(argv=None) -> int:
                 state.active += 1
             try:
                 tokens = [token_at(prompt, i) for i in range(n)]
+                # prefix-cache accounting: hit = leading digest run of
+                # the prompt chain already cached here; only uncached
+                # prompt tokens pay the simulated prefill cost. The full
+                # prompt+reply chain is published afterwards, exactly
+                # like the real radix cache after decode.
+                chain = fingerprint_chain(prompt, state.prefix_block)
+                with state.lock:
+                    hit = min(
+                        state.prefix.overlap("self", chain)
+                        * state.prefix_block,
+                        len(prompt))
+                    state.prefix_hit_tokens += hit
+                    state.prefix.observe("self", chain)
+                if prefill_delay:
+                    time.sleep(prefill_delay * (len(prompt) - hit))
                 if req.get("stream"):
                     self.send_response(200)
                     self.send_header(
@@ -243,6 +285,8 @@ def main(argv=None) -> int:
                     self._json(200, {"tokens": tokens})
                 with state.lock:
                     state.completed += 1
+                    state.prefix.observe("self", fingerprint_chain(
+                        prompt + tokens, state.prefix_block))
                 state.m_completed.inc()
                 state.e2e.observe(time.monotonic() - t0)
             except (ConnectionError, BrokenPipeError):

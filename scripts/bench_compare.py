@@ -37,6 +37,14 @@ INFORMATIONAL = {
     "kv_recompute_tokens_saved",
     "kv_pressure_preemptions",
     "kv_pressure_preemptions_off",
+    # router A/B: the round-robin arm is the baseline side of the
+    # comparison, context rather than a number to defend round-over-round
+    # (the gated router_* keys are the prefix arm and the ratios)
+    "router_requests",
+    "router_round_robin_tok_per_sec",
+    "router_round_robin_p50_ttft_ms",
+    "router_round_robin_p99_ttft_ms",
+    "router_round_robin_hit_tokens_per_request",
 }
 
 # non-numeric context keys, never compared

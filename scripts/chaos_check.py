@@ -70,6 +70,11 @@ REQUIRED_CHAOS_MODULES = (
     # budget-exhausted replica must degrade while survivors keep
     # serving verified streams
     "test_serving_fleet",
+    # prefix-aware routing gateway (ISSUE 19): the routed replica dying
+    # mid-stream must surface as a rerouted retry with zero corrupted
+    # outcomes — the gateway never replays bytes into a half-written
+    # client stream
+    "test_serving_router",
 )
 
 

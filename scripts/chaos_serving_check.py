@@ -1,6 +1,6 @@
 """Serving chaos gate: composed failure weather over a live replica fleet.
 
-Four scenarios, each against a real (stub-replica) fleet with real
+Five scenarios, each against a real (stub-replica) fleet with real
 subprocesses, sockets and streams — run ``--repeats`` times (default 3)
 so a flaky pass can't sneak through:
 
@@ -20,6 +20,12 @@ so a flaky pass can't sneak through:
    to min after the stabilization window. The emitted fleet.scale_up /
    fleet.scale_down events must match that trajectory, and the burst's
    traffic must still resolve with zero corrupted streams.
+5. **router-kill-prefix-hot** — chat traffic flows through the
+   prefix-aware routing gateway, concentrating shared-prefix sessions
+   on one replica; SIGKILL that prefix-hot replica mid-wave. The
+   gateway must reroute with zero corrupted and zero hung streams, the
+   fleet must return to all-healthy, and a post-recovery wave's p99
+   TTFT must re-converge to the healthy baseline.
 
 Usage:
     python scripts/chaos_serving_check.py [--repeats N] [--scenario NAME]
@@ -230,11 +236,91 @@ def scenario_burst_then_idle() -> dict:
         fleet.stop()
 
 
+def scenario_router_kill_prefix_hot() -> dict:
+    from devspace_tpu.serving.gateway import RoutingGateway
+    from devspace_tpu.serving.router import PrefixRouter, RouterConfig
+
+    fleet = ReplicaFleet(
+        spec=fast_spec(STUB_TOKEN_DELAY_S="0.01"), replicas=3,
+        poll_interval=0.1)
+    fleet.start()
+    gw = None
+    try:
+        router = PrefixRouter(
+            replicas_fn=fleet.targets,
+            # admission off: the gate's invariants are reroute + TTFT
+            # re-convergence, and outcomes must repeat exactly
+            config=RouterConfig(admission=False))
+        gw = RoutingGateway(router, port=0)
+        gw.start()
+
+        def run_wave(seed):
+            trace = generate_trace(TraceSpec(
+                seed=seed, kind="chat", duration_s=2.0, rate_rps=10,
+                turns=(2, 3), max_new_tokens=(16, 24)))
+            gen = LoadGenerator(
+                lambda: {"gw": gw.base_url}, request_timeout_s=10,
+                hang_timeout_s=25, max_attempts=4)
+            return trace, gen
+
+        # wave 1: healthy baseline through the gateway
+        trace, gen = run_wave(21)
+        healthy = gen.run(trace)
+        counts = healthy.counts()
+        check(counts["corrupted"] == 0, f"baseline corrupted: {counts}")
+        check(counts["hung"] == 0, f"baseline hung: {counts}")
+        p99_healthy = healthy.ttft_quantile(0.99)
+
+        # wave 2: SIGKILL the replica holding the most shadow chains
+        trace, gen = run_wave(22)
+        import threading
+
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.__setitem__("report", gen.run(trace)),
+            daemon=True)
+        th.start()
+        time.sleep(0.5)  # routed streams in flight
+        blocks = router.stats()["shadow_blocks"]
+        hot = max(sorted(blocks), key=lambda n: blocks[n])
+        fleet.kill(hot)
+        th.join(timeout=60)
+        check(not th.is_alive(), "router-wave loadgen did not finish")
+        counts = box["report"].counts()
+        check(len(box["report"].outcomes) == len(trace),
+              f"unresolved: {len(box['report'].outcomes)}/{len(trace)}")
+        check(counts["corrupted"] == 0, f"corrupted streams: {counts}")
+        check(counts["hung"] == 0, f"hung requests: {counts}")
+        wait_for(fleet.all_healthy, 20, "fleet recovery after router kill")
+
+        # wave 3: p99 TTFT must re-converge to the healthy baseline
+        trace, gen = run_wave(23)
+        recovered = gen.run(trace)
+        counts3 = recovered.counts()
+        check(counts3["corrupted"] == 0, f"post-recovery: {counts3}")
+        p99_after = recovered.ttft_quantile(0.99)
+        bound = max(2.5 * p99_healthy, p99_healthy + 0.25)
+        check(p99_after <= bound,
+              f"p99 TTFT did not re-converge: {p99_after:.3f}s vs "
+              f"healthy {p99_healthy:.3f}s (bound {bound:.3f}s)")
+        retries = int(router.registry.snapshot()
+                      ["serving_router_retries_total"]["samples"][0][1])
+        return {"victim": hot, "kill_wave_counts": counts,
+                "p99_ttft_healthy_s": round(p99_healthy, 4),
+                "p99_ttft_recovered_s": round(p99_after, 4),
+                "retries_total": retries}
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
 SCENARIOS = {
     "kill-mid-stream": scenario_kill_mid_stream,
     "hang-replica": scenario_hang_replica,
     "metrics-garbage": scenario_metrics_garbage,
     "burst-then-idle": scenario_burst_then_idle,
+    "router-kill-prefix-hot": scenario_router_kill_prefix_hot,
 }
 
 

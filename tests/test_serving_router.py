@@ -1,0 +1,536 @@
+"""Prefix-aware router tests: golden decision tables + live gateway.
+
+The decision-core tests mirror tests/test_serving_autoscale.py: loads
+and clock are injected, every expected replica choice is hand-computed
+from the scoring formula in devspace_tpu/serving/router.py, and the
+tables pin the RouterConfig defaults — change a weight and these fail
+loudly with the arithmetic to re-derive.
+
+The live tests run real stub subprocesses behind a real gateway. The
+chaos-marked test (registered in scripts/chaos_check.py) SIGKILLs the
+routed replica mid-stream and requires the retry to reroute with ZERO
+corrupted outcomes — the gateway must never replay bytes into a
+half-written client stream.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from devspace_tpu.inference.prefix_cache import _chain_digest, fingerprint_chain
+from devspace_tpu.serving import ReplicaFleet, ReplicaSpec
+from devspace_tpu.serving.gateway import RoutingGateway
+from devspace_tpu.serving.loadgen import LoadGenerator, TraceSpec, generate_trace
+from devspace_tpu.serving.router import (
+    ADMIT,
+    QUEUE,
+    REJECT,
+    PrefixRouter,
+    ReplicaLoad,
+    RouterConfig,
+    ShadowRadixIndex,
+    loads_from_collector,
+)
+
+
+def counter_value(router, name: str) -> float:
+    fam = router.registry.snapshot().get(name)
+    if not fam or not fam["samples"]:
+        return 0.0
+    return float(fam["samples"][0][1])
+
+
+# -- fingerprint chain -------------------------------------------------------
+def test_fingerprint_chain_matches_chain_digest():
+    ids = list(range(20))
+    chain = fingerprint_chain(ids, 8)
+    d0 = _chain_digest("", tuple(ids[0:8]))
+    d1 = _chain_digest(d0, tuple(ids[8:16]))
+    assert chain == [d0, d1]  # trailing partial block (4 ids) excluded
+
+
+def test_fingerprint_chain_edges():
+    assert fingerprint_chain([], 8) == []
+    assert fingerprint_chain([1, 2, 3], 8) == []  # under one block
+    assert len(fingerprint_chain([1, 2, 3], 1)) == 3
+    with pytest.raises(ValueError):
+        fingerprint_chain([1], 0)
+    # chains are prefix-consistent: extending the ids extends the chain
+    a = fingerprint_chain(list(range(16)), 8)
+    b = fingerprint_chain(list(range(24)), 8)
+    assert b[: len(a)] == a
+
+
+# -- shadow radix index ------------------------------------------------------
+def test_shadow_overlap_is_leading_run_only():
+    ix = ShadowRadixIndex()
+    chain = fingerprint_chain(list(range(32)), 8)  # 4 digests
+    ix.observe("r0", chain[:2])
+    assert ix.overlap("r0", chain) == 2
+    assert ix.overlap("r1", chain) == 0
+    # a hole breaks the run: radix rule, block K needs blocks 0..K-1
+    ix2 = ShadowRadixIndex()
+    ix2.observe("r0", [chain[0], chain[2]])
+    assert ix2.overlap("r0", chain) == 1
+
+
+def test_shadow_lru_eviction_and_drop():
+    ix = ShadowRadixIndex(max_blocks=2)
+    ix.observe("r0", ["a", "b"])
+    ix.overlap("r0", ["a"])        # touch "a" — "b" becomes LRU
+    ix.observe("r0", ["c"])        # evicts "b"
+    assert ix.overlap("r0", ["a"]) == 1
+    assert ix.overlap("r0", ["b"]) == 0
+    assert ix.blocks("r0") == 2
+    ix.drop_replica("r0")
+    assert ix.total_blocks() == 0
+
+
+# -- golden decision tables --------------------------------------------------
+def make_router(replicas=("a", "b"), loads=None, **cfg_kw):
+    cfg_kw.setdefault("policy", "prefix")
+    loads = dict(loads or {})
+    return PrefixRouter(
+        replicas_fn=lambda: {n: f"http://{n}" for n in replicas},
+        loads_fn=lambda: loads,
+        config=RouterConfig(**cfg_kw),
+        clock=lambda: 0.0,
+    )
+
+
+def test_cold_start_ties_break_by_name():
+    r = make_router(replicas=("b", "a", "c"))
+    d = r.route(list(range(16)))
+    assert (d.admission, d.replica, d.spilled) == (ADMIT, "a", False)
+    assert d.scores == {"a": 0.0, "b": 0.0, "c": 0.0}
+
+
+def test_prefix_affinity_sticks_to_the_chain_holder():
+    r = make_router()
+    prompt = list(range(16))  # exactly 2 blocks at block_size=8
+    first = r.route(prompt)
+    r.complete(first.replica, service_s=0.1)
+    again = r.route(prompt)
+    # overlap 16/16 on "a": score a = 1.0*1.0 - 0 - 0 = 1.0, b = 0.0
+    assert (again.replica, again.overlap_tokens) == ("a", 16)
+    assert again.scores["a"] == 1.0 and again.scores["b"] == 0.0
+    # a longer prompt sharing the prefix still maps to the holder:
+    # overlap 16 of 32 tokens -> score a = 0.5
+    r.complete("a", service_s=0.1)
+    longer = r.route(list(range(32)))
+    assert (longer.replica, longer.overlap_tokens) == ("a", 16)
+    assert longer.scores["a"] == 0.5
+
+
+def test_hot_prefix_holder_spills_to_next_best():
+    # "a" holds the whole chain (overlap ratio 1.0) but is loaded:
+    #   load(a) = occupancy 1.0 + queued 6/6 + 0.5*0 = 2.0
+    #   score(a) = 1.0*1.0 - 0.6*2.0 = -0.2 ;  score(b) = 0 - 0 = 0.0
+    loads = {"a": ReplicaLoad(occupancy=1.0, queued=6, max_slots=6,
+                              active=6)}
+    r = make_router(loads=loads, admission=False)
+    prompt = list(range(16))
+    r.shadow.observe("a", fingerprint_chain(prompt, 8))
+    d = r.route(prompt)
+    assert (d.replica, d.spilled) == ("b", True)
+    assert d.scores["a"] == pytest.approx(-0.2)
+    assert d.scores["b"] == 0.0
+    assert counter_value(r, "serving_router_spillovers_total") == 1
+
+
+def test_slo_pressure_is_part_of_the_load_term():
+    # equal otherwise, but "a" is in TTFT-burn warn (pressure 1.0):
+    #   score(a) = -0.6 * (0 + 0 + 0.5*1.0) = -0.3 < score(b) = 0
+    loads = {"a": ReplicaLoad(slo_pressure=1.0), "b": ReplicaLoad()}
+    r = make_router(loads=loads)
+    d = r.route(list(range(16)))
+    assert d.replica == "b"
+    assert d.scores["a"] == pytest.approx(-0.3)
+
+
+def test_fairness_steers_a_dominating_tenant_away():
+    r = make_router()
+    prompt_alice = list(range(100, 108))
+    for _ in range(2):  # alice takes "a" twice (tie-break, then prefix)
+        d = r.route(prompt_alice, tenant="alice")
+        assert d.replica == "a"
+        r.complete("a", service_s=0.1)
+    d = r.route(list(range(200, 208)), tenant="bob")  # bob: ties -> "a"
+    assert d.replica == "a"
+    r.complete("a", service_s=0.1)
+    # window(a) = [alice, alice, bob]; tenants {alice, bob} -> fair 1/2
+    # alice's share on a = 2/3 -> penalty 1/6; fresh prompt, no overlap:
+    #   score(a) = -0.4 * 1/6 = -0.0667 < score(b) = 0  -> steered to b
+    d = r.route(list(range(300, 308)), tenant="alice")
+    assert d.replica == "b"
+    assert d.scores["a"] == pytest.approx(-0.4 / 6)
+    # anonymous traffic never pays a fairness penalty
+    d2 = r.route(list(range(400, 408)))
+    assert d2.scores["a"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_least_loaded_policy_ignores_prefixes():
+    loads = {"a": ReplicaLoad(occupancy=0.5), "b": ReplicaLoad()}
+    r = make_router(loads=loads, policy="least_loaded")
+    prompt = list(range(16))
+    r.shadow.observe("a", fingerprint_chain(prompt, 8))
+    d = r.route(prompt)
+    assert (d.replica, d.overlap_tokens) == ("b", 0)
+    assert d.scores == {"a": -0.5, "b": 0.0}
+
+
+def test_round_robin_cycles_in_name_order():
+    r = make_router(replicas=("c", "a", "b"), policy="round_robin")
+    picks = [r.route([1, 2, 3, 4]).replica for _ in range(4)]
+    assert picks == ["a", "b", "c", "a"]
+
+
+def test_admission_bands_queue_then_reject():
+    # projected_ttft = (queued + active)/slots * default_service_s(0.2)
+    # vs target 1.0s: burn >= 1 queues, burn >= 6 rejects.
+    r = make_router(loads={"a": ReplicaLoad(queued=4, active=1),
+                           "b": ReplicaLoad(queued=4, active=1)})
+    d = r.route(list(range(8)))
+    assert d.admission == QUEUE
+    assert d.projected_ttft_s == pytest.approx(1.0)
+
+    r2 = make_router(loads={"a": ReplicaLoad(queued=29, active=1),
+                            "b": ReplicaLoad(queued=29, active=1)})
+    d2 = r2.route(list(range(8)))
+    assert d2.admission == REJECT
+    assert d2.projected_ttft_s == pytest.approx(6.0)
+    assert counter_value(r2, "serving_router_rejected_total") == 1
+
+    r3 = make_router(replicas=("a",),
+                     loads={"a": ReplicaLoad(queued=29, active=1)},
+                     admission=False)
+    assert r3.route(list(range(8))).admission == ADMIT
+
+
+def test_requeue_counts_the_queue_exactly_once():
+    r = make_router(loads={"a": ReplicaLoad(queued=4, active=1),
+                           "b": ReplicaLoad(queued=4, active=1)})
+    prompt = list(range(8))
+    assert r.route(prompt).admission == QUEUE
+    assert r.route(prompt, requeue=True).admission == QUEUE
+    assert counter_value(r, "serving_router_queued_total") == 1
+
+
+def test_stamp_false_mutates_nothing():
+    r = make_router()
+    prompt = list(range(16))
+    d = r.route(prompt, stamp=False)
+    assert d.admission == ADMIT
+    assert r.shadow.total_blocks() == 0
+    assert counter_value(r, "serving_router_requests_total") == 0
+    assert r.stats()["inflight"] == {}
+
+
+def test_inflight_blends_with_scraped_load():
+    # no scrape data at all: the router's own in-flight count still
+    # produces back-pressure (1 in-flight / 1 slot -> occupancy 1.0)
+    r = make_router(admission=False)
+    prompt_a = list(range(16))
+    r.route(prompt_a)  # lands on "a", stays in flight
+    d = r.route(list(range(50, 58)))  # fresh prompt
+    assert d.replica == "b"
+    assert d.scores["a"] == pytest.approx(-0.6)
+    r.complete("a", service_s=0.1)
+    r.complete("b", service_s=0.1)
+    assert r.stats()["inflight"] == {}
+
+
+def test_forget_replica_clears_its_shadow():
+    r = make_router()
+    prompt = list(range(16))
+    r.route(prompt)
+    assert r.shadow.blocks("a") == 2
+    r.forget_replica("a")
+    assert r.shadow.blocks("a") == 0
+    d = r.route(prompt)  # state gone: cold tie-break again, no overlap
+    assert d.overlap_tokens == 0
+
+
+def test_service_ewma_updates_on_success_only():
+    r = make_router()
+    r.route(list(range(8)))
+    r.complete("a", service_s=1.2, ok=True)
+    # ewma: 0.8*0.2 + 0.2*1.2 = 0.4
+    assert r.stats()["service_s"]["a"] == pytest.approx(0.4)
+    r.route(list(range(8)))
+    r.complete("a", ok=False)  # failures never poison the EWMA
+    assert r.stats()["service_s"]["a"] == pytest.approx(0.4)
+
+
+def test_loads_from_collector_shapes():
+    class FakeTarget:
+        def __init__(self, name, snapshot, up=True, quarantined=False,
+                     health=None):
+            self.name, self.snapshot = name, snapshot
+            self.up, self.quarantined = up, quarantined
+            self.health = health or {}
+
+    def fam(v):
+        return {"samples": [({}, v)], "kind": "gauge", "help": ""}
+
+    snap = {
+        "engine_dispatch_depth_occupancy": fam(0.5),
+        "engine_queued_requests": fam(3.0),
+        "engine_max_slots": fam(4.0),
+        "engine_active_slots": fam(2.0),
+    }
+
+    class FakeCollector:
+        targets = [
+            FakeTarget("r0", snap,
+                       health={"slo": {"status": "warn"}}),
+            FakeTarget("r1", snap, up=False),        # down: skipped
+            FakeTarget("r2", None),                  # unscraped: skipped
+            FakeTarget("r3", snap, quarantined=True),
+        ]
+
+    loads = loads_from_collector(FakeCollector())
+    assert sorted(loads) == ["r0"]
+    r0 = loads["r0"]
+    assert (r0.occupancy, r0.queued, r0.max_slots, r0.active,
+            r0.slo_pressure) == (0.5, 3.0, 4.0, 2.0, 1.0)
+
+
+def test_no_replicas_rejects():
+    r = PrefixRouter(replicas_fn=dict, clock=lambda: 0.0)
+    d = r.route([1, 2, 3])
+    assert d.admission == REJECT and "no routable replicas" in d.reason
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(policy="sticky").validate()
+    with pytest.raises(ValueError):
+        RouterConfig(block_size=0).validate()
+    with pytest.raises(ValueError):
+        RouterConfig(warn_burn=2.0, breach_burn=1.0).validate()
+
+
+# -- live gateway over a real stub fleet -------------------------------------
+def wait_for(cond, timeout=20.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def fast_fleet(replicas=2, **env):
+    env.setdefault("STUB_TOKEN_DELAY_S", "0.002")
+    return ReplicaFleet(spec=ReplicaSpec(env=env), replicas=replicas,
+                        poll_interval=0.1)
+
+
+def make_gateway(fleet, **cfg_kw):
+    cfg_kw.setdefault("policy", "prefix")
+    router = PrefixRouter(replicas_fn=fleet.targets,
+                          config=RouterConfig(**cfg_kw))
+    gw = RoutingGateway(router, port=0)
+    gw.start()
+    return gw
+
+
+def gw_get(gw, path):
+    with urllib.request.urlopen(gw.base_url + path, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def gw_stream(gw, prompt, n):
+    body = json.dumps({"prompt_ids": prompt, "max_new_tokens": n,
+                       "stream": True}).encode()
+    req = urllib.request.Request(gw.base_url + "/generate", data=body)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return [json.loads(line) for line in resp]
+
+
+def test_gateway_streams_verified_and_sticks_to_prefix_holder():
+    from devspace_tpu.serving.stub import token_at
+
+    fleet = fast_fleet(replicas=2)
+    fleet.start()
+    gw = None
+    try:
+        gw = make_gateway(fleet)
+        prompt = list(range(16))
+        lines = gw_stream(gw, prompt, 5)
+        assert [m["token"] for m in lines[:-1]] == [
+            token_at(prompt, i) for i in range(5)]
+        assert lines[-1] == {"done": True}
+        # the follow-up turn (prompt + reply grown) routes to the same
+        # replica and the stub's own prefix memory reports hit tokens
+        grown = prompt + [token_at(prompt, i) for i in range(5)] + [7] * 8
+        gw_stream(gw, grown, 3)
+        _, dbg = gw_get(gw, "/debug/router")
+        picks = [d["replica"] for d in dbg["recent_decisions"]]
+        assert len(set(picks)) == 1
+        assert dbg["recent_decisions"][-1]["overlap_tokens"] >= 16
+        url = fleet.targets()[picks[0]]
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        hits = [line for line in text.splitlines()
+                if line.startswith("engine_prefix_hit_tokens_total ")]
+        assert hits and float(hits[0].split()[1]) >= 16
+        # gateway surfaces its own catalog + health endpoints
+        with urllib.request.urlopen(
+                gw.base_url + "/metrics", timeout=10) as resp:
+            assert "serving_router_requests_total 2" in resp.read().decode()
+        assert gw_get(gw, "/healthz")[0] == 200
+        assert gw_get(gw, "/readyz")[0] == 200
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
+def test_gateway_admission_rejects_with_429():
+    router = PrefixRouter(
+        replicas_fn=lambda: {"a": "http://127.0.0.1:1"},
+        loads_fn=lambda: {"a": ReplicaLoad(queued=40, active=1)},
+        config=RouterConfig(queue_timeout_s=0.2),
+    )
+    gw = RoutingGateway(router, port=0)
+    gw.start()
+    try:
+        body = json.dumps({"prompt_ids": [1, 2, 3], "max_new_tokens": 2,
+                           "stream": True}).encode()
+        req = urllib.request.Request(gw.base_url + "/generate", data=body)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 429
+        assert "breach band" in json.loads(exc.value.read())["reason"]
+    finally:
+        gw.stop()
+
+
+def test_gateway_drain_flips_readyz():
+    router = PrefixRouter(replicas_fn=lambda: {"a": "http://127.0.0.1:1"})
+    gw = RoutingGateway(router, port=0)
+    gw.start()
+    try:
+        assert gw_get(gw, "/readyz")[0] == 200
+        req = urllib.request.Request(gw.base_url + "/drain", data=b"{}")
+        urllib.request.urlopen(req, timeout=10)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(gw.base_url + "/readyz", timeout=10)
+        assert exc.value.code == 503
+    finally:
+        gw.stop()
+
+
+def test_gateway_reroutes_before_first_byte():
+    # one dead address in the routing table: the gateway must absorb the
+    # connect failure, drop the dead replica's shadow state, and serve
+    # the stream from the live one — the client never sees the failure
+    fleet = fast_fleet(replicas=1)
+    fleet.start()
+    gw = None
+    try:
+        def targets():
+            t = dict(fleet.targets())
+            t["dead"] = "http://127.0.0.1:9"  # discard port: refused
+            return t
+
+        router = PrefixRouter(replicas_fn=targets, config=RouterConfig())
+        # pre-warm the dead replica's shadow so routing prefers it
+        prompt = list(range(16))
+        router.shadow.observe("dead", fingerprint_chain(prompt, 8))
+        gw = RoutingGateway(router, port=0)
+        gw.start()
+        lines = gw_stream(gw, prompt, 4)
+        assert lines[-1] == {"done": True}
+        snap = router.registry.snapshot()
+        assert snap["serving_router_retries_total"]["samples"][0][1] == 1
+        assert router.shadow.blocks("dead") == 0  # forgotten on failure
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
+# -- chaos (registered in scripts/chaos_check.py) ----------------------------
+@pytest.mark.chaos
+def test_routed_replica_killed_mid_stream_reroutes_clean():
+    """SIGKILL the replica currently holding the routed streams. Every
+    client stream must end completed or retried — zero corrupted, zero
+    hung: the gateway aborts half-written streams instead of replaying,
+    and the loadgen's retry rides a fresh routing decision."""
+    fleet = fast_fleet(replicas=2, STUB_TOKEN_DELAY_S="0.01")
+    fleet.start()
+    gw = None
+    try:
+        # admission off: this test is about reroute-on-death, and the
+        # outcome must be deterministic across the chaos gate's repeats
+        gw = make_gateway(fleet, admission=False)
+        gen = LoadGenerator(targets_fn=lambda: {"gw": gw.base_url},
+                            hang_timeout_s=60.0, max_attempts=4)
+        # one shared prefix -> all streams route to one replica, so the
+        # kill provably lands on routed traffic
+        base = list(range(24))
+        trace = [{"id": i, "at": 0.0, "prompt_ids": base,
+                  "max_new_tokens": 40, "sampled": False, "session": 0}
+                 for i in range(6)]
+
+        killed = {}
+
+        def kill_routed():
+            wait_for(
+                lambda: gw.router.stats()["recent_decisions"],
+                msg="first routed decision")
+            time.sleep(0.15)  # let streams get bytes in flight
+            name = gw.router.stats()["recent_decisions"][-1]["replica"]
+            killed["name"] = name
+            fleet.kill(name)
+
+        import threading
+
+        killer = threading.Thread(target=kill_routed, daemon=True)
+        killer.start()
+        report = gen.run(trace)
+        killer.join(timeout=30)
+        counts = report.counts()
+        assert counts["corrupted"] == 0, report.to_dict()
+        assert counts["hung"] == 0, report.to_dict()
+        assert counts["failed"] == 0, report.to_dict()
+        assert counts["completed"] + counts["retried"] == len(trace)
+        assert killed, "kill thread never fired"
+        # the supervisor restarts the killed replica behind the gateway
+        wait_for(fleet.all_healthy, msg="fleet recovered after kill")
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
+# -- rag trace shape (loadgen satellite) -------------------------------------
+def test_rag_trace_is_byte_stable_and_shares_contexts():
+    from devspace_tpu.serving.loadgen import trace_json
+
+    spec = TraceSpec(kind="rag", seed=11, duration_s=4.0, rate_rps=10,
+                     rag_contexts=2, rag_context_len=(64, 96),
+                     rag_long_fraction=0.4)
+    assert trace_json(spec) == trace_json(spec)
+    trace = generate_trace(spec)
+    assert trace, "empty rag trace"
+    long = [e for e in trace if e["session"] >= 0]
+    short = [e for e in trace if e["session"] == -1]
+    assert long and short, "rag must interleave long and short prompts"
+    # every long query embeds its context verbatim as the prompt prefix
+    by_ctx = {}
+    for e in long:
+        by_ctx.setdefault(e["session"], []).append(e["prompt_ids"])
+    for prompts in by_ctx.values():
+        ctx_len = min(len(p) for p in prompts) - 1
+        head = prompts[0][:64]  # at least the min context length
+        assert all(p[:64] == head for p in prompts)
+        assert ctx_len >= 64
+    assert max(len(e["prompt_ids"]) for e in long) > max(
+        len(e["prompt_ids"]) for e in short)
