@@ -1303,6 +1303,173 @@ def bench_router() -> dict:
     }
 
 
+def bench_disagg() -> dict:
+    """Disaggregated prefill/decode A/B (ISSUE 20): the same mixed
+    short-chat + long-RAG trace replayed through the routing gateway
+    over a fresh 3-replica stub fleet, once with two-phase placement
+    (replica-2 as the dedicated prefill pool, KV chains migrated to the
+    decode replicas) and once unified. The stub replicas model
+    continuous-batching interference (``STUB_PREFILL_INTERFERENCE``): an
+    active cold prefill stretches co-located decode steps, so unified
+    placement makes short requests stall behind long RAG prefills —
+    disaggregation must cut the SHORT-class p99 TTFT >=1.3x while
+    aggregate tok/s stays within 5%. Host-side subprocesses only; the
+    regression guard for the ``disagg_*`` keys."""
+    import urllib.request
+
+    from devspace_tpu.obs.collector import TelemetryCollector
+    from devspace_tpu.serving import (
+        LoadGenerator,
+        ReplicaFleet,
+        ReplicaSpec,
+        TraceSpec,
+        generate_trace,
+    )
+    from devspace_tpu.serving.gateway import RoutingGateway
+    from devspace_tpu.serving.router import (
+        PrefixRouter,
+        RouterConfig,
+        loads_from_collector,
+    )
+    from devspace_tpu.utils.log import StdoutLogger
+
+    # 36 contexts / ~24 long arrivals: most longs are FIRST-touch, so
+    # the unified arm cannot self-segregate via prefix affinity — every
+    # replica keeps eating cold ~300-token prefills that stall its
+    # co-located decodes. Long-prefill work (~15 x 0.3s) fits one pool
+    # replica; decode work dominates, so giving up a third of decode
+    # capacity is affordable. 6s of arrivals so the drain tail (where
+    # the two-phase hop adds fixed serial latency) is amortized.
+    trace = generate_trace(TraceSpec(
+        seed=20, kind="rag", duration_s=6.0, rate_rps=20,
+        rag_contexts=36, rag_context_len=(256, 384),
+        rag_long_fraction=0.2, max_new_tokens=(8, 16)))
+    short_ids = {e["id"] for e in trace if e["session"] == -1}
+
+    def short_ttft_quantile(report, q: float) -> float:
+        lat = sorted(o.ttft_s for o in report.outcomes
+                     if o.id in short_ids and o.ttft_s > 0
+                     and o.outcome in ("completed", "retried"))
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+    def run_arm(disagg: bool) -> dict:
+        # fresh fleet per arm: both start with cold caches, identical
+        # capacity — disaggregation REASSIGNS replica-2, never adds one
+        fleet = ReplicaFleet(
+            spec=ReplicaSpec(env={
+                # decode-heavy balance: a cold ~300-token RAG context
+                # bills ~0.25s of prefill, a 12-token reply ~0.24s of
+                # decode — long-prefill work (~17 x 0.25s over 6s) keeps
+                # one pool replica ~70% busy, the regime where dedicating
+                # 1 of 3 replicas to prefill pays
+                "STUB_TOKEN_DELAY_S": "0.02",
+                "STUB_PREFILL_DELAY_PER_TOKEN_S": "0.0008",
+                "STUB_MAX_SLOTS": "6",
+                # continuous-batching interference: while a prefill is
+                # active, co-located decode steps stretch 5x (1 + 4*1).
+                # This is the DistServe effect disaggregation removes —
+                # migrated KV restores are not billed as prefill, so
+                # decode replicas in the disagg arm stay interference-free
+                "STUB_PREFILL_INTERFERENCE": "8",
+            }),
+            replicas=3, poll_interval=0.1,
+            logger=StdoutLogger(stream=sys.stderr),
+        )
+        fleet.start()
+        gw = coll = None
+        try:
+            coll = TelemetryCollector.from_replicas([], interval_s=0.2)
+            coll.refresh(sorted(fleet.targets().items()))
+            coll.scrape_once()
+            coll.start()
+            cfg = dict(policy="prefix", admission=False)
+            if disagg:
+                # threshold 96: cold RAG contexts (~300 uncached tokens)
+                # take the two-phase path; follow-up queries on a cached
+                # context (<30 uncached) prefill locally — migrating
+                # those would churn the pool for no saved wall time
+                # occupancy band above 1.0: occupancy can never reach
+                # it, so the token threshold is the ONLY trigger and
+                # short requests are never two-phased — the A/B measures
+                # long-prefill offload, not band-induced migration churn
+                cfg.update(prefill_pool=("replica-2",),
+                           disagg_threshold_tokens=96,
+                           disagg_occupancy_band=2.0)
+            router = PrefixRouter(
+                replicas_fn=fleet.targets,
+                loads_fn=lambda: loads_from_collector(coll),
+                config=RouterConfig(**cfg))
+            gw = RoutingGateway(router, port=0)
+            gw.start()
+            gen = LoadGenerator(
+                lambda: {"gw": gw.base_url},
+                request_timeout_s=30, hang_timeout_s=60, max_attempts=3)
+            report = gen.run(trace)
+            counts = report.counts()
+            bad = counts["corrupted"] + counts["hung"] + counts["failed"]
+            if bad:
+                arm = "disagg" if disagg else "unified"
+                raise RuntimeError(
+                    f"disagg bench arm {arm} lost streams: {counts}")
+            migrated_chains = migrated_bytes = fallbacks = 0.0
+            for url in fleet.targets().values():
+                with urllib.request.urlopen(
+                        url + "/metrics", timeout=5) as resp:
+                    for line in resp.read().decode().splitlines():
+                        if line.startswith("engine_kv_migrate_chains_total "):
+                            migrated_chains += float(line.split()[1])
+                        elif line.startswith("engine_kv_migrate_bytes_total "):
+                            migrated_bytes += float(line.split()[1])
+                        elif line.startswith(
+                                "engine_kv_restore_fallbacks_total "):
+                            fallbacks += float(line.split()[1])
+            snap = router.registry.snapshot()
+            dispatches = float(
+                snap["serving_router_prefill_dispatches_total"]
+                ["samples"][0][1])
+            return {
+                "tok_per_sec": report.total_tokens() / report.wall_s,
+                "short_p50_ttft_ms": short_ttft_quantile(report, 0.50) * 1000,
+                "short_p99_ttft_ms": short_ttft_quantile(report, 0.99) * 1000,
+                "migrated_chains": migrated_chains,
+                "migrated_bytes": migrated_bytes,
+                "fallbacks": fallbacks,
+                "dispatches": dispatches,
+            }
+        finally:
+            if gw is not None:
+                gw.stop()
+            if coll is not None:
+                coll.stop()
+            fleet.stop()
+
+    dis = run_arm(disagg=True)
+    uni = run_arm(disagg=False)
+    return {
+        "disagg_requests": len(trace),
+        "disagg_short_requests": len(short_ids),
+        "disagg_short_p50_ttft_ms": round(dis["short_p50_ttft_ms"], 1),
+        "disagg_short_p99_ttft_ms": round(dis["short_p99_ttft_ms"], 1),
+        "disagg_unified_short_p50_ttft_ms": round(
+            uni["short_p50_ttft_ms"], 1),
+        "disagg_unified_short_p99_ttft_ms": round(
+            uni["short_p99_ttft_ms"], 1),
+        "disagg_short_p99_ttft_speedup": round(
+            uni["short_p99_ttft_ms"] / max(1e-9, dis["short_p99_ttft_ms"]),
+            3),
+        "disagg_tok_per_sec": round(dis["tok_per_sec"], 1),
+        "disagg_unified_tok_per_sec": round(uni["tok_per_sec"], 1),
+        "disagg_tok_per_sec_ratio": round(
+            dis["tok_per_sec"] / max(1e-9, uni["tok_per_sec"]), 3),
+        "disagg_prefill_dispatches": int(dis["dispatches"]),
+        "disagg_migrated_chains": int(dis["migrated_chains"]),
+        "disagg_migrated_kb": round(dis["migrated_bytes"] / 1024, 1),
+        "disagg_recompute_fallbacks": int(dis["fallbacks"]),
+    }
+
+
 def main() -> int:
     if os.environ.get("DEVSPACE_BENCH_WEDGE_CHILD") and (
         "--resnet-child" in sys.argv
@@ -1421,6 +1588,44 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             notes.append(f"router bench failed: {e}")
             log(f"[bench] router bench failed: {e}")
+    # disaggregated prefill/decode A/B (ISSUE 20): mixed short+long RAG
+    # trace, two-phase placement vs unified on fresh stub fleets — real
+    # subprocesses and ~20s of wall, so it yields to the budget
+    disagg_ab = None
+    if remaining_budget() < 60.0:
+        notes.append("disagg bench skipped (budget exhausted)")
+        log(f"[bench] disagg bench skipped — {remaining_budget():.0f}s left")
+    else:
+        try:
+            disagg_ab = bench_disagg()
+            log(
+                "[bench] disagg A/B (mixed rag trace, 3 replicas): "
+                f"short p99 TTFT {disagg_ab['disagg_short_p99_ttft_ms']}ms "
+                f"vs unified {disagg_ab['disagg_unified_short_p99_ttft_ms']}"
+                f"ms ({disagg_ab['disagg_short_p99_ttft_speedup']}x); "
+                f"tok/s ratio {disagg_ab['disagg_tok_per_sec_ratio']}; "
+                f"{disagg_ab['disagg_migrated_chains']} chains / "
+                f"{disagg_ab['disagg_migrated_kb']}KB migrated, "
+                f"{disagg_ab['disagg_recompute_fallbacks']} recompute "
+                "fallbacks"
+            )
+            if disagg_ab["disagg_short_p99_ttft_speedup"] < 1.3:
+                notes.append(
+                    "disagg bench: short-request p99 TTFT below the "
+                    "1.3x bar "
+                    f"({disagg_ab['disagg_short_p99_ttft_speedup']}x)")
+            if disagg_ab["disagg_tok_per_sec_ratio"] < 0.95:
+                notes.append(
+                    "disagg bench: aggregate tok/s fell more than 5% "
+                    "under disaggregation "
+                    f"({disagg_ab['disagg_tok_per_sec_ratio']}x unified)")
+            if disagg_ab["disagg_migrated_chains"] < 1:
+                notes.append(
+                    "disagg bench: no KV chain ever migrated — the "
+                    "two-phase path did not engage")
+        except Exception as e:  # noqa: BLE001
+            notes.append(f"disagg bench failed: {e}")
+            log(f"[bench] disagg bench failed: {e}")
     sync_latency = None
     try:
         sync_latency = bench_sync_latency()
@@ -1606,6 +1811,8 @@ def main() -> int:
         "fleet_recovery_ms": fleet_recovery_ms,
         # prefix-aware routing A/B over the gateway (ISSUE 19)
         **(router_ab or {}),
+        # disaggregated prefill/decode A/B over the gateway (ISSUE 20)
+        **(disagg_ab or {}),
     }
     hb(f"bench done (status={status})")
     print(json.dumps(result))

@@ -1237,10 +1237,23 @@ def cmd_fleet(args) -> int:
             loads_from_collector,
         )
 
+        raw_pool = (getattr(args, "prefill_pool", "") or "").strip()
+        if raw_pool.isdigit():
+            pool = tuple(sorted(fleet.targets())[: int(raw_pool)])
+        else:
+            pool = tuple(
+                p.strip() for p in raw_pool.split(",") if p.strip())
         router = PrefixRouter(
             replicas_fn=fleet.targets,
             loads_fn=lambda: loads_from_collector(collector),
-            config=RouterConfig(policy=args.route),
+            config=RouterConfig(
+                policy=args.route,
+                prefill_pool=pool,
+                disagg_threshold_tokens=getattr(
+                    args, "disagg_threshold", 0),
+                disagg_occupancy_band=getattr(
+                    args, "disagg_occupancy_band", 0.85),
+            ),
         )
         gateway = RoutingGateway(
             router, host=args.host, port=args.gateway_port)
@@ -2569,6 +2582,31 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=8080,
         help="routing gateway port (with --route; 0 picks a free port)",
+    )
+    q.add_argument(
+        "--prefill-pool",
+        default="",
+        metavar="N|NAMES",
+        help="(with --route) reserve replicas for disaggregated prefill: "
+        "a count (the first N by name) or comma-separated replica names; "
+        "pool members take phase-1 prefills but no decode streams",
+    )
+    q.add_argument(
+        "--disagg-threshold",
+        type=int,
+        default=0,
+        metavar="TOKENS",
+        help="(with --route) uncached-prompt-token threshold that "
+        "triggers two-phase placement: prefill elsewhere, then decode "
+        "with a kv_source KV-chain pull (0 = disabled)",
+    )
+    q.add_argument(
+        "--disagg-occupancy-band",
+        type=float,
+        default=0.85,
+        metavar="FRAC",
+        help="decode-target occupancy at/above which even short prompts "
+        "prefill elsewhere (with --disagg-threshold)",
     )
     q.set_defaults(fn=cmd_fleet)
     q = fleet_sub.add_parser(

@@ -74,6 +74,9 @@ from ..obs.tracing import (
 from .dispatch import DecodeDispatcher, resolve_dispatch_depth
 from .kv_tier import (
     HostKVTier,
+    KVMigrationClient,
+    import_chain,
+    pack_chain_envelope,
     pack_kv_payload,
     resolve_kv_tier,
     unpack_kv_payload,
@@ -131,6 +134,29 @@ ENGINE_METRIC_FAMILIES = (
     ("engine_kv_restore_seconds", "histogram",
      "Latency of one spilled-chain restore (tier reads + scatter "
      "dispatches; async device work excluded)", None, "sum"),
+    # KV migration (disaggregated prefill/decode, ISSUE 20): chains
+    # pulled from a peer replica's /kv/chain endpoint into the local
+    # tier, and chain envelopes this replica served to peers
+    ("engine_kv_migrate_chains_total", "counter",
+     "KV chains fetched from a peer replica and imported into the "
+     "local tier", "kv_migrate_chains", "sum"),
+    ("engine_kv_migrate_blocks_total", "counter",
+     "KV blocks promoted remote->spilled from imported migration "
+     "envelopes", "kv_migrate_blocks", "sum"),
+    ("engine_kv_migrate_bytes_total", "counter",
+     "Envelope bytes fetched in successful KV migrations",
+     "kv_migrate_bytes", "sum"),
+    ("engine_kv_migrate_failures_total", "counter",
+     "KV migration attempts that failed (fetch error or wire-format "
+     "rejection) and degraded to recompute-prefill",
+     "kv_migrate_failures", "sum"),
+    ("engine_kv_export_chains_total", "counter",
+     "KV chain envelopes served to peer replicas via /kv/chain",
+     "kv_export_chains", "sum"),
+    ("engine_kv_migrate_seconds", "histogram",
+     "Latency of one KV chain migration (fetch + import + promote; "
+     "the host->device scatter is counted by the restore path)",
+     None, "sum"),
     ("engine_decode_dispatches_total", "counter",
      "Decode chunks dispatched by the overlapped serving loop",
      "decode_dispatches", "sum"),
@@ -227,6 +253,12 @@ class Request:
     # parsed by ServingTelemetry.on_submit so the request's lifecycle
     # trace joins the caller's trace instead of rooting a fresh one
     traceparent: Optional[str] = None
+    # disaggregated prefill (ISSUE 20): base URL of the replica that
+    # already holds this prompt's prefilled KV chain. At admission the
+    # engine marks the uncovered prompt blocks "remote" and the restore
+    # path pulls their wire envelope from here; any failure degrades to
+    # recompute-prefill. Ignored without a host KV tier.
+    kv_source: Optional[str] = None
     # filled by the engine
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
@@ -626,6 +658,19 @@ class InferenceEngine:
         self.kv_restore_hits = 0
         self.kv_restore_fallbacks = 0
         self._kv_restore_hist = None  # set by _register_metric_families
+        # KV migration (disaggregated prefill/decode, ISSUE 20)
+        self.kv_migrate_chains = 0
+        self.kv_migrate_blocks = 0
+        self.kv_migrate_bytes = 0
+        self.kv_migrate_failures = 0
+        self.kv_export_chains = 0
+        self._kv_migrate_hist = None  # set by _register_metric_families
+        # lazy KVMigrationClient; tests inject one with a fetch_fn
+        self._kv_client = None
+        # export mailbox: /kv/chain handler threads post (digest, box)
+        # here and the SCHEDULER services them between iterations — it
+        # is the only thread that may read the pool/cache/tier
+        self._kv_export_requests: queue.Queue = queue.Queue()
         self.slots = [_Slot() for _ in range(max_slots)]
         self.pending: queue.Queue[Request] = queue.Queue()
         self._resume: list[Request] = []  # preempted, re-admit first
@@ -1069,6 +1114,7 @@ class InferenceEngine:
         min_new_tokens: int = 0,
         logit_bias: Optional[dict[int, float]] = None,
         traceparent: Optional[str] = None,
+        kv_source: Optional[str] = None,
     ) -> Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -1106,6 +1152,7 @@ class InferenceEngine:
             min_new_tokens=int(min_new_tokens),
             logit_bias=logit_bias,
             traceparent=traceparent,
+            kv_source=kv_source,
         )
         # trace BEFORE the queue put: the scheduler may admit the request
         # the instant it lands, and on_admit is a no-op without the trace
@@ -1294,6 +1341,13 @@ class InferenceEngine:
             ),
             "kv_tier_entries": len(self._kv_tier) if self._kv_tier else 0,
             "kv_tier_spilled_nodes": self._prefix_cache.spilled_count(),
+            "kv_tier_remote_nodes": self._prefix_cache.remote_count(),
+            # KV migration (disaggregated prefill/decode)
+            "kv_migrate_chains": self.kv_migrate_chains,
+            "kv_migrate_blocks": self.kv_migrate_blocks,
+            "kv_migrate_bytes": self.kv_migrate_bytes,
+            "kv_migrate_failures": self.kv_migrate_failures,
+            "kv_export_chains": self.kv_export_chains,
             "queued": self.pending.qsize() + len(self._resume),
             "uptime_s": round(uptime, 1),
             "tokens_per_sec": round(self.tokens_generated / uptime, 2)
@@ -1389,6 +1443,8 @@ class InferenceEngine:
                 # not pull callbacks over stats() ints
                 if name == "engine_kv_restore_seconds":
                     self._kv_restore_hist = reg.histogram(name, help_)
+                elif name == "engine_kv_migrate_seconds":
+                    self._kv_migrate_hist = reg.histogram(name, help_)
                 continue
             reg.register_callback(name, kind, help_, reader(key))
 
@@ -1599,6 +1655,18 @@ class InferenceEngine:
         bs = self.block_size
         t0 = time.monotonic()
         overlapped = self._dispatcher.in_flight > 0
+        # phase 0 (network): any REMOTE run in the chain is fetched from
+        # its source replica and imported into the local tier, promoting
+        # the covered nodes to spilled. A failed/partial migration
+        # leaves nodes remote, whose tier reads below MISS — so every
+        # migration failure rides the same drop-spilled ->
+        # recompute-prefill ladder as a lost local payload.
+        remote = [
+            d for d in spilled
+            if self._prefix_cache.remote_source(d) is not None
+        ]
+        if remote:
+            self._migrate_remote(slot_idx, remote)
         # phase 1 (host): prefetch + validate the chain's payloads —
         # all tier reads happen BEFORE any block pops, so eviction churn
         # from our own pops can't invalidate a payload we still need
@@ -1714,6 +1782,147 @@ class InferenceEngine:
             seconds=round(now - t0, 6),
         )
         return restored
+
+    # -- KV migration (disaggregated prefill/decode, ISSUE 20) -------------
+    def _mark_remote_chain(self, prompt: list, source: str) -> None:
+        """Record that every full prompt block not already covered by
+        the radix tree is fetchable from ``source``: a cursor walk that
+        descends through resident/spilled nodes untouched and inserts
+        REMOTE nodes past the frontier (``Cursor.publish_remote``)."""
+        bs = self.block_size
+        cur = self._prefix_cache.cursor()
+        for i in range((len(prompt) - 1) // bs):
+            cur.publish_remote(tuple(prompt[i * bs : (i + 1) * bs]), source)
+
+    def _migrate_client(self) -> KVMigrationClient:
+        if self._kv_client is None:
+            self._kv_client = KVMigrationClient()
+        return self._kv_client
+
+    def _migrate_remote(self, slot_idx: int, remote: list) -> None:
+        """Fetch the wire envelope covering a remote run (ONE pull for
+        the whole run, leaf-addressed) and import it into the local
+        tier, promoting covered nodes remote -> spilled. Failures leave
+        the nodes remote — the caller's tier reads then miss and the
+        ordinary fallback ladder recomputes. Never raises."""
+        source = self._prefix_cache.remote_source(remote[0])
+        t0 = time.monotonic()
+        req = self.slots[slot_idx].req
+        trace = getattr(req, "_obs_trace", None) if req is not None else None
+        trace_id = trace.trace_id if trace is not None else None
+        try:
+            envelope = self._migrate_client().fetch(source, remote[-1])
+            imported = set(import_chain(self._kv_tier, envelope))
+        except Exception as e:  # noqa: BLE001 — any fault => recompute
+            self.kv_migrate_failures += 1
+            _events.emit(
+                "kv_tier", "migrate_failed", level="warn",
+                trace_id=trace_id, slot=slot_idx, source=source,
+                digest=remote[-1][:16], blocks=len(remote),
+                reason=type(e).__name__,
+            )
+            return
+        promoted = 0
+        for d in remote:
+            # promote only the gap-free covered prefix: a node past a
+            # gap is unrestorable (its ancestors would miss first)
+            if d in imported and self._prefix_cache.promote_remote(d):
+                promoted += 1
+            else:
+                break
+        now = time.monotonic()
+        self.kv_migrate_chains += 1
+        self.kv_migrate_blocks += promoted
+        self.kv_migrate_bytes += len(envelope)
+        if self._kv_migrate_hist is not None:
+            self._kv_migrate_hist.observe(now - t0)
+        if trace is not None:
+            trace.event(f"kv_migrate:{promoted}", now)
+        tl = self._timeline
+        if tl is not None:
+            tl.add(
+                TRACK_TIER_RESTORE, f"migrate x{promoted}", t0, now,
+                slot=slot_idx, blocks=promoted, bytes=len(envelope),
+                trace_id=trace_id,
+            )
+        _events.emit(
+            "kv_tier", "migrate", trace_id=trace_id, slot=slot_idx,
+            source=source, blocks=promoted, requested=len(remote),
+            bytes=len(envelope), seconds=round(now - t0, 6),
+        )
+
+    def export_kv_chain(
+        self, digest: str, timeout: float = 5.0
+    ) -> Optional[bytes]:
+        """Chain envelope for ``digest`` (the whole root->leaf run it
+        names), for a peer replica's migration pull — the replica
+        server's ``GET /kv/chain/<digest>``. Thread-safe: the request is
+        mailboxed to the scheduler thread, the only one allowed to read
+        the pool/cache/tier (with no scheduler running — tests, offline
+        tools — it is served inline). None for unknown digests, with
+        the tier off, or on timeout."""
+        if self._kv_tier is None:
+            return None
+        if self._thread is not None and self._thread.is_alive():
+            box: dict = {"done": threading.Event(), "envelope": None}
+            self._kv_export_requests.put((digest, box))
+            if not box["done"].wait(timeout):
+                return None
+            return box["envelope"]
+        return self._serve_kv_export(digest)
+
+    def _service_kv_exports(self) -> None:
+        """Drain the export mailbox (scheduler thread, between
+        iterations)."""
+        while True:
+            try:
+                digest, box = self._kv_export_requests.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box["envelope"] = self._serve_kv_export(digest)
+            except Exception:  # noqa: BLE001 — a failed export is a 404
+                box["envelope"] = None
+            finally:
+                box["done"].set()
+
+    def _serve_kv_export(self, digest: str) -> Optional[bytes]:
+        """Build the envelope: resident chain blocks are gathered
+        device->host (batched, same shape discipline as the spill path)
+        and packed; spilled ones read from the tier. Serves the longest
+        gap-free prefix — the importer promotes exactly what arrives.
+        Scheduler thread (or no scheduler) only."""
+        chain = self._prefix_cache.chain_to(digest)
+        if not chain:
+            return None
+        resident = [(d, blk) for d, blk in chain if blk >= 0]
+        payloads: dict[str, bytes] = {}
+        R = _RESTORE_BATCH
+        for lo in range(0, len(resident), R):
+            group = resident[lo : lo + R]
+            idx = [blk for _, blk in group] + [0] * (R - len(group))
+            kq, ks, vq, vs = self._gather_chain_jit(
+                self.pool, jnp.asarray(idx, jnp.int32)
+            )
+            kq, ks, vq, vs = jax.device_get((kq, ks, vq, vs))  # lint: allow(JIT502)
+            for n, (d, _) in enumerate(group):
+                payloads[d] = pack_kv_payload(
+                    kq[:, n], ks[:, n], vq[:, n], vs[:, n]
+                )
+        blocks: list = []
+        for d, blk in chain:
+            payload = payloads.get(d) if blk >= 0 else self._kv_tier.get(d)
+            if payload is None:
+                break  # gap: nothing below it is restorable
+            blocks.append((d, payload))
+        if not blocks:
+            return None
+        self.kv_export_chains += 1
+        _events.emit(
+            "kv_tier", "migrate_export", digest=digest[:16],
+            blocks=len(blocks),
+        )
+        return pack_chain_envelope(blocks)
 
     def _publish_prefix_blocks(self, slot_idx: int) -> None:
         """Make this slot's fully-written full prompt blocks matchable.
@@ -1904,6 +2113,16 @@ class InferenceEngine:
         prompt = req.prompt_ids + req.tokens  # tokens: preempted resume
         if self._kv_tier is not None:
             matched, spilled = self._match_prefix_tiered(prompt)
+            # disaggregated prefill: a kv_source hint promises the
+            # uncovered prompt blocks at a peer replica — mark them
+            # REMOTE so the restore path fetches their envelope instead
+            # of recompute-prefilling (any failure falls back there)
+            if req.kv_source and (
+                len(matched) + len(spilled)
+                < (len(prompt) - 1) // self.block_size
+            ):
+                self._mark_remote_chain(prompt, req.kv_source)
+                matched, spilled = self._match_prefix_tiered(prompt)
         else:
             matched, spilled = self._match_prefix(prompt), []
         # spilled blocks are NOT subtracted from need: each restore pops
@@ -2382,6 +2601,8 @@ class InferenceEngine:
         d = self._dispatcher
         while not self._stop.is_set():
             t_iter = time.monotonic()
+            if not self._kv_export_requests.empty():
+                self._service_kv_exports()
             self._admit_pending()
             prefilling = [
                 i

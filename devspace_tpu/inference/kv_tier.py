@@ -319,6 +319,218 @@ class HostKVTier:
         }
 
 
+# -- KV migration wire format (disaggregated prefill/decode) --------------
+#
+# A chain envelope is the unit of KV migration between replicas: one
+# self-describing byte string holding a contiguous run of packed KV
+# blocks (each the exact ``pack_kv_payload`` bytes the host tier already
+# stores — shapes and dtype ride in each block's own KVT1 header) plus
+# enough redundancy to reject every transport failure cleanly:
+#
+#   magic     b"KVM1"
+#   version   <H>  (skew -> WireFormatError, never a misparse)
+#   chain     <H len><ascii>  the LEAF digest (names the whole chain)
+#   count     <I>
+#   blocks    count x [<H len><ascii digest> <16s checksum> <I len> payload]
+#   trailer   16-byte blake2b over everything above
+#
+# The importer verifies the trailer, every per-block checksum, and each
+# payload's KVT1 structure before anything touches the local tier — a
+# truncated/bit-flipped/mis-versioned envelope raises WireFormatError
+# and the decode engine falls back to recompute-prefill.
+
+_WIRE_MAGIC = b"KVM1"
+_WIRE_VERSION = 1
+
+
+class WireFormatError(ValueError):
+    """A chain envelope failed structural/integrity/version checks."""
+
+
+def _validate_block_payload(digest: str, payload: bytes) -> None:
+    """Structural check of one packed block (magic + dims-implied length)
+    WITHOUT copying it out — shapes/dtype are declared by the KVT1
+    header and must account for every byte."""
+    if payload[:4] != _MAGIC:
+        raise WireFormatError(f"block {digest[:16]}: bad payload magic")
+    if len(payload) < 20:
+        raise WireFormatError(f"block {digest[:16]}: truncated header")
+    L, Hkv, bs, D = struct.unpack_from("<4I", payload, 4)
+    n_q, n_s = L * Hkv * bs * D, L * Hkv * bs
+    want = 4 + 16 + 2 * n_q + 2 * 4 * n_s
+    if len(payload) != want:
+        raise WireFormatError(
+            f"block {digest[:16]}: payload length {len(payload)} != "
+            f"{want} implied by dims ({L},{Hkv},{bs},{D})"
+        )
+
+
+def pack_chain_envelope(blocks: "list[tuple[str, bytes]]") -> bytes:
+    """Pack an ordered (root->leaf) run of ``(digest, payload)`` blocks
+    into one versioned wire envelope. The last digest names the chain."""
+    if not blocks:
+        raise ValueError("cannot pack an empty chain")
+    leaf = blocks[-1][0].encode("ascii")
+    parts = [
+        _WIRE_MAGIC,
+        struct.pack("<H", _WIRE_VERSION),
+        struct.pack("<H", len(leaf)),
+        leaf,
+        struct.pack("<I", len(blocks)),
+    ]
+    for digest, payload in blocks:
+        d = digest.encode("ascii")
+        parts.append(struct.pack("<H", len(d)))
+        parts.append(d)
+        parts.append(_checksum(payload))
+        parts.append(struct.pack("<I", len(payload)))
+        parts.append(payload)
+    body = b"".join(parts)
+    return body + _checksum(body)
+
+
+def unpack_chain_envelope(buf: bytes) -> "list[tuple[str, bytes]]":
+    """Inverse of :func:`pack_chain_envelope`. Verifies the envelope
+    trailer, per-block checksums and per-block KVT1 structure; raises
+    :class:`WireFormatError` on ANY mismatch (truncation, bit flip,
+    version skew) so a migration failure is always a clean rejection."""
+    if len(buf) < 4 + 2 + 2 + 4 + _CHECKSUM_SIZE:
+        raise WireFormatError("envelope too short")
+    if buf[:4] != _WIRE_MAGIC:
+        raise WireFormatError("bad envelope magic")
+    body, trailer = buf[:-_CHECKSUM_SIZE], buf[-_CHECKSUM_SIZE:]
+    if _checksum(body) != trailer:
+        raise WireFormatError("envelope checksum mismatch")
+    (version,) = struct.unpack_from("<H", buf, 4)
+    if version != _WIRE_VERSION:
+        raise WireFormatError(
+            f"envelope version {version} != supported {_WIRE_VERSION}"
+        )
+    off = 6
+    (dlen,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    leaf = buf[off : off + dlen].decode("ascii")
+    off += dlen
+    (count,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    blocks: list[tuple[str, bytes]] = []
+    end = len(body)
+    for _ in range(count):
+        if off + 2 > end:
+            raise WireFormatError("envelope truncated in block header")
+        (dlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        digest = buf[off : off + dlen].decode("ascii")
+        off += dlen
+        checksum = buf[off : off + _CHECKSUM_SIZE]
+        off += _CHECKSUM_SIZE
+        if off + 4 > end:
+            raise WireFormatError("envelope truncated in block header")
+        (plen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        if off + plen > end:
+            raise WireFormatError("envelope truncated in block payload")
+        payload = buf[off : off + plen]
+        off += plen
+        if _checksum(payload) != checksum:
+            raise WireFormatError(f"block {digest[:16]}: checksum mismatch")
+        _validate_block_payload(digest, payload)
+        blocks.append((digest, payload))
+    if off != end:
+        raise WireFormatError("trailing bytes after last block")
+    if not blocks or blocks[-1][0] != leaf:
+        raise WireFormatError("leaf digest does not name the last block")
+    return blocks
+
+
+def export_chain(tier: HostKVTier, digests: "list[str]") -> Optional[bytes]:
+    """Build a chain envelope from payloads the tier holds. Returns None
+    when ANY digest misses (a partial chain is unrestorable below the
+    gap — the caller serves what it can by trimming ``digests`` first)."""
+    blocks: list[tuple[str, bytes]] = []
+    for digest in digests:
+        payload = tier.get(digest)
+        if payload is None:
+            return None
+        blocks.append((digest, payload))
+    if not blocks:
+        return None
+    return pack_chain_envelope(blocks)
+
+
+def import_chain(tier: HostKVTier, buf: bytes) -> "list[str]":
+    """Validate ``buf`` (raising :class:`WireFormatError`) and retain
+    every block in the local tier. Returns the digests in chain order —
+    the caller promotes the matching remote radix nodes to spilled."""
+    blocks = unpack_chain_envelope(buf)
+    for digest, payload in blocks:
+        tier.put(digest, payload)
+    return [digest for digest, _ in blocks]
+
+
+class KVMigrateError(RuntimeError):
+    """A chain fetch failed for a non-retryable reason (unknown digest
+    at the source, migration disabled there)."""
+
+
+class KVMigrationClient:
+    """HTTP pull client for ``GET <source>/kv/chain/<digest>``, retried
+    under the resilience :class:`~devspace_tpu.resilience.policy.RetryPolicy`
+    (transient transport errors only — a 404 means the source no longer
+    holds the chain and fails fast as :class:`KVMigrateError`). A custom
+    ``fetch_fn(source, digest) -> bytes`` replaces the HTTP transport
+    for in-process tests."""
+
+    def __init__(
+        self,
+        retry=None,
+        timeout_s: float = 5.0,
+        fetch_fn=None,
+    ):
+        if retry is None:
+            from ..resilience.policy import RetryPolicy
+
+            retry = RetryPolicy(
+                max_attempts=3,
+                base_delay=0.05,
+                max_delay=0.5,
+                jitter=0.5,
+                retry_on=(OSError,),
+                seed=0,
+            )
+        self.retry = retry
+        self.timeout_s = timeout_s
+        self._fetch_fn = fetch_fn
+
+    def _fetch_once(self, source: str, digest: str) -> bytes:
+        if self._fetch_fn is not None:
+            return self._fetch_fn(source, digest)
+        import urllib.error
+        import urllib.request
+
+        url = f"{source.rstrip('/')}/kv/chain/{digest}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KVMigrateError(f"chain not held by source: {url}") from None
+            raise OSError(f"kv fetch http {e.code}: {url}") from None
+
+    def fetch(self, source: str, digest: str) -> bytes:
+        """The chain envelope for ``digest`` from ``source``. Raises
+        :class:`KVMigrateError` (gone at source) or the resilience
+        layer's exhaustion error; the engine maps either to
+        recompute-prefill."""
+        return self.retry.execute(
+            self._fetch_once,
+            source,
+            digest,
+            describe=f"kv chain fetch {digest[:16]}",
+            reraise=True,
+        )
+
+
 def resolve_kv_tier(kv_tier: Optional[str]) -> str:
     """Tier-mode resolution, mirroring ``resolve_dispatch_depth``: the
     explicit constructor arg wins, then the ``DEVSPACE_KV_TIER`` env knob,

@@ -55,9 +55,24 @@ content-address the tier stores payloads under. With
 ``track_digests=False`` (the default) no spilled node can ever exist
 and every code path below is byte-identical to the untiered cache.
 
+**Remote location** (disaggregated prefill/decode, ISSUE 20): a fourth
+state beyond resident/spilled/gone. A *remote* node is in the tree with
+``blk == -1`` like a spilled one, but its payload lives in ANOTHER
+replica's KV tier (``Cursor.publish_remote`` records the source). The
+engine's restore path first fetches the remote run's wire envelope from
+the source and imports it into the local tier, *promoting* each covered
+node remote -> spilled (``promote_remote``); from there the ordinary
+spilled restore ladder applies — so every migration failure (fetch
+error, checksum mismatch, version skew, source missing the chain)
+degrades through the same drop-spilled -> recompute-prefill path, never
+corruption.
+
     resident --pop_victim(collect_spill)--> spilled --publish--> resident
     resident --pop_victim()------------------------------------> gone
     spilled --drop_spilled / broken ancestor chain-------------> gone
+    (absent) --publish_remote--> remote --promote_remote--> spilled
+    remote --publish (recompute republish)-----------------> resident
+    remote --drop_spilled / broken ancestor chain----------> gone
 """
 
 from __future__ import annotations
@@ -106,7 +121,8 @@ class _Node:
     larger = more recently matched/published). ``digest`` is the chain
     content address (tiered mode only, else None). State encoding:
     resident (``blk >= 0``, in ``_by_block``), spilled (``blk == -1``,
-    in ``_spilled``, still in ``parent.children``), gone (detached).
+    in ``_spilled``, still in ``parent.children``), remote (``blk ==
+    -1``, in ``_remote``, payload on another replica), gone (detached).
     ``live`` is the heap-validity flag: True only while resident."""
 
     __slots__ = (
@@ -152,17 +168,45 @@ class Cursor:
         (LRU-touched, like :meth:`step`), ``("spill", digest)`` for a
         spilled one (no touch — spilled nodes are outside the LRU; the
         engine restores the digest's payload into a fresh block and
-        revives the node via :meth:`publish`), None when the chain
-        ends."""
+        revives the node via :meth:`publish`), ``("remote", digest)``
+        for a remote one (payload on another replica — the engine
+        fetches its wire envelope and promotes it to spilled before
+        restoring), None when the chain ends."""
         child = self._node.children.get(edge)
         if child is None:
             return None
         if child.blk < 0:
             self._node = child
+            if child.digest in self._cache._remote:
+                return ("remote", child.digest)
             return ("spill", child.digest)
         self._cache._touch(child)
         self._node = child
         return ("res", child.blk)
+
+    def publish_remote(self, edge: tuple, source: str) -> Optional[str]:
+        """Record that the NEXT block of this chain is held by another
+        replica (``source`` is its base URL): descend by ``edge``,
+        inserting a REMOTE node (``blk == -1``, payload fetchable from
+        ``source``) when the chain ends here. Returns the node's chain
+        digest. An existing child in ANY state is left untouched (a
+        resident/spilled copy is strictly better than a remote promise;
+        an existing remote node keeps its original source) — the cursor
+        just descends. Tiered mode only."""
+        cache = self._cache
+        if not cache._track_digests:
+            raise RuntimeError("publish_remote requires track_digests=True")
+        child = self._node.children.get(edge)
+        if child is not None:
+            self._node = child
+            return child.digest
+        node = _Node(edge, self._node, -1, 0, 0)
+        node.live = False
+        node.digest = _chain_digest(self._node.digest or "", edge)
+        self._node.children[edge] = node
+        cache._remote[node.digest] = (node, source)
+        self._node = node
+        return node.digest
 
     def publish(self, edge: tuple, blk: int, refs: int) -> int:
         """Publish one block: descend by ``edge``, inserting a node for
@@ -187,6 +231,7 @@ class Cursor:
             child.live = True
             cache._by_block[blk] = child
             cache._spilled.pop(child.digest, None)
+            cache._remote.pop(child.digest, None)
             if refs == 0:
                 cache._evictable += 1
                 heapq.heappush(cache._heap, (child.touch, id(child), child))
@@ -224,6 +269,8 @@ class RadixPrefixCache:
         self._by_block: dict[int, _Node] = {}
         # digest -> spilled node (tiered mode; empty otherwise)
         self._spilled: dict[str, _Node] = {}
+        # digest -> (remote node, source URL): payload on another replica
+        self._remote: dict[str, tuple[_Node, str]] = {}
         self._clock = 0
         # lazy min-heap of (touch, tiebreak, node) eviction candidates:
         # entries go stale when the node is re-touched, re-referenced or
@@ -239,6 +286,52 @@ class RadixPrefixCache:
     def spilled_count(self) -> int:
         """Spilled (host-tier-backed) nodes currently matchable."""
         return len(self._spilled)
+
+    def remote_count(self) -> int:
+        """Remote (other-replica-backed) nodes currently matchable."""
+        return len(self._remote)
+
+    def remote_source(self, digest: str) -> Optional[str]:
+        """The source URL a remote node's payload is fetchable from, or
+        None when ``digest`` is not a remote node."""
+        entry = self._remote.get(digest)
+        return entry[1] if entry is not None else None
+
+    def chain_to(self, digest: str) -> Optional[list[tuple[str, int]]]:
+        """The root->leaf ``(digest, blk)`` line ending at the node whose
+        chain digest is ``digest`` (``blk == -1`` for spilled/remote
+        entries), or None when unknown. The KV export path uses this to
+        serve a peer's migration pull. Resident leaves cost a scan of
+        ``_by_block`` — no digest index is maintained because exports
+        are rare (one per migration) and the hot paths stay lean."""
+        node = self._spilled.get(digest)
+        if node is None:
+            entry = self._remote.get(digest)
+            node = entry[0] if entry is not None else None
+        if node is None:
+            for n in self._by_block.values():
+                if n.digest == digest:
+                    node = n
+                    break
+        if node is None:
+            return None
+        chain: list[tuple[str, int]] = []
+        while node is not None and node.parent is not None:
+            chain.append((node.digest, node.blk))
+            node = node.parent
+        chain.reverse()
+        return chain
+
+    def promote_remote(self, digest: str) -> bool:
+        """remote -> spilled: the payload for ``digest`` has been
+        imported into the LOCAL tier (migration fetch succeeded), so the
+        node is now restorable through the ordinary spilled ladder.
+        Returns False for unknown digests."""
+        entry = self._remote.pop(digest, None)
+        if entry is None:
+            return False
+        self._spilled[digest] = entry[0]
+        return True
 
     def is_published(self, blk: int) -> bool:
         return blk in self._by_block
@@ -335,9 +428,10 @@ class RadixPrefixCache:
         stack = [(n, spill) for n in victim.children.values()]
         while stack:
             n, ok = stack.pop()
-            if n.blk < 0:  # spilled by an earlier eviction
+            if n.blk < 0:  # spilled/remote from an earlier transition
                 if not ok:
                     self._spilled.pop(n.digest, None)
+                    self._remote.pop(n.digest, None)
                     if dropped is not None:
                         dropped.append(n.digest)
                     del n.parent.children[n.edge]
@@ -386,9 +480,14 @@ class RadixPrefixCache:
         digests for the caller to discard from the tier, plus the
         blocks of any resident ref-0 descendants (defensive — the
         spill/restore protocol revives top-down, so resident nodes
-        below a spilled one should not arise). No-op for unknown
+        below a spilled one should not arise). Also prunes REMOTE
+        nodes (a failed migration drops its promised chain the same
+        way a tier miss drops a spilled one). No-op for unknown
         digests."""
         node = self._spilled.pop(digest, None)
+        if node is None:
+            entry = self._remote.pop(digest, None)
+            node = entry[0] if entry is not None else None
         dropped: list[str] = []
         freed: list[int] = []
         if node is None:
@@ -400,6 +499,7 @@ class RadixPrefixCache:
             n = stack.pop()
             if n.blk < 0:
                 self._spilled.pop(n.digest, None)
+                self._remote.pop(n.digest, None)
                 dropped.append(n.digest)
                 n.live = False
             else:
@@ -416,6 +516,7 @@ class RadixPrefixCache:
         self._root.digest = ""
         self._by_block.clear()
         self._spilled.clear()
+        self._remote.clear()
         self._heap.clear()
         self._evictable = 0
 

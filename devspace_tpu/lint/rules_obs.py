@@ -42,6 +42,7 @@ _UNITLESS_GAUGE_SUFFIXES = (
     "_up",
     "_quarantined",
     "_replicas",
+    "_tokens",
 )
 _RATE_RE = re.compile(r"_per_sec(_\d+s)?$")
 _KINDS = ("counter", "gauge", "histogram")

@@ -84,6 +84,9 @@ EVENT_CATALOG = (
     ("kv_tier", "restore", "Spilled prefix blocks restored into the device pool"),
     ("kv_tier", "restore_fallback", "Tier restore failed; prefix recomputed"),
     ("kv_tier", "corrupt_drop", "Tier payload failed checksum and was dropped"),
+    ("kv_tier", "migrate", "KV chain pulled from a peer replica and imported"),
+    ("kv_tier", "migrate_failed", "KV chain pull failed; degrading to recompute-prefill"),
+    ("kv_tier", "migrate_export", "KV chain envelope served to a peer replica"),
     ("resilience", "circuit_open", "Circuit breaker opened after repeated failures"),
     ("resilience", "circuit_close", "Circuit breaker closed after a probe success"),
     ("resilience", "retries_exhausted", "Retry policy gave up after max attempts"),
@@ -91,6 +94,8 @@ EVENT_CATALOG = (
     ("router", "spillover", "Request steered off its best prefix holder (it was hot)"),
     ("router", "request_rejected", "SLO-aware admission shed a request (breach band)"),
     ("router", "retry_rerouted", "Request rerouted after its replica failed before first byte"),
+    ("router", "prefill_dispatched", "Two-phase placement: prompt prefilled on a separate replica"),
+    ("router", "prefill_failed", "Phase-1 prefill call failed; degrading to unified placement"),
     ("serving", "drain_started", "Serving process entered drain mode (readyz 503, healthz live)"),
     ("serving", "drain_cleared", "Serving process left drain mode and readmits traffic"),
     ("slo", "warn", "SLO burn rate crossed the warn threshold"),

@@ -297,20 +297,34 @@ class IdleBackoff:
     """Adaptive wait for poll loops: the timeout grows while the stream is
     idle and snaps back on activity. Replaces fixed ``timeout=0.2`` polls
     that wake 5x/second on streams that are quiet for hours (the log-mux
-    busy loop)."""
+    busy loop).
+
+    ``jitter`` shaves up to that fraction off each returned wait (drawn
+    from a private seeded RNG, like :class:`RetryPolicy`), so many
+    pollers backing off from the same event don't re-poll in lockstep —
+    the gateway's QUEUE re-poll loop is the motivating caller."""
 
     def __init__(
-        self, initial: float = 0.05, maximum: float = 1.0, multiplier: float = 2.0
+        self,
+        initial: float = 0.05,
+        maximum: float = 1.0,
+        multiplier: float = 2.0,
+        jitter: float = 0.0,
+        seed: Optional[int] = None,
     ):
         self.initial = initial
         self.maximum = maximum
         self.multiplier = multiplier
+        self.jitter = jitter
+        self._rng = random.Random(seed)
         self._current = initial
 
     def next_wait(self) -> float:
         """Current wait; each idle call grows the next one up to maximum."""
         wait = self._current
         self._current = min(self.maximum, self._current * self.multiplier)
+        if self.jitter > 0:
+            wait *= 1.0 - self.jitter * self._rng.random()
         return wait
 
     def reset(self) -> None:

@@ -41,6 +41,7 @@ import urllib.request
 from typing import Callable, Optional
 
 from ..obs import events as obs_events
+from ..resilience.policy import IdleBackoff
 from .router import ADMIT, QUEUE, REJECT, PrefixRouter
 
 # endpoints proxied verbatim to the routed replica
@@ -69,6 +70,7 @@ class RoutingGateway:
         self.request_timeout_s = request_timeout_s
         self.queue_poll_s = queue_poll_s
         self._clock = clock
+        self._sleep = time.sleep  # injectable for the QUEUE re-poll test
         self.draining = False
         self._httpd = self._build_server(host, port)
         self._thread: Optional[threading.Thread] = None
@@ -102,17 +104,34 @@ class RoutingGateway:
                exclude: frozenset = frozenset()):
         """Run the admission loop: route, and if queued, re-poll until
         the projection clears or the queue deadline expires. Returns
-        (decision, queue_wait_s)."""
+        (decision, queue_wait_s).
+
+        The re-poll wait is a jittered :class:`IdleBackoff`, not a fixed
+        sleep: while the projection is unchanged the wait doubles (no
+        point hammering a router whose view hasn't moved), and any
+        projection change snaps it back to ``queue_poll_s`` — so many
+        queued requests backing off from the same hot replica neither
+        re-poll in lockstep nor sleep through the capacity they were
+        waiting for."""
         router = self.router
         decision = router.route(prompt_ids, tenant=tenant, exclude=exclude)
         if decision.admission != QUEUE:
             return decision, 0.0
         t0 = self._clock()
         deadline = t0 + router.config.queue_timeout_s
+        backoff = IdleBackoff(
+            initial=self.queue_poll_s,
+            maximum=max(self.queue_poll_s,
+                        router.config.queue_timeout_s / 8),
+            jitter=0.5, seed=0)
+        last_projection = decision.projected_ttft_s
         while self._clock() < deadline:
-            time.sleep(self.queue_poll_s)
+            self._sleep(backoff.next_wait())
             decision = router.route(
                 prompt_ids, tenant=tenant, requeue=True, exclude=exclude)
+            if decision.projected_ttft_s != last_projection:
+                backoff.reset()  # state moved: poll eagerly again
+                last_projection = decision.projected_ttft_s
             if decision.admission != QUEUE:
                 wait = self._clock() - t0
                 router.h_queue_wait.observe(max(0.0, wait))
@@ -136,6 +155,39 @@ class RoutingGateway:
             url + "/generate", data=body,
             headers={"Content-Type": "application/json", **headers})
         return urllib.request.urlopen(req, timeout=self.request_timeout_s)
+
+    def _phase1_prefill(self, decision, body: bytes,
+                        headers: dict) -> Optional[str]:
+        """Two-phase placement, phase 1: run the prompt's prefill on
+        ``decision.prefill_replica`` and return that replica's base URL
+        (the decode request's ``kv_source``). ANY failure returns None —
+        the request degrades to unified placement and the decode replica
+        prefills locally; nothing is ever half-migrated."""
+        router = self.router
+        name = decision.prefill_replica
+        tokens = max(0, decision.prompt_tokens - decision.overlap_tokens)
+        url = router.replicas_fn().get(name)
+        if not url:
+            router.prefill_complete(name, tokens, ok=False)
+            obs_events.emit(
+                "router", "prefill_failed", level="warn",
+                prefill_replica=name, error="replica not routable")
+            return None
+        try:
+            req = urllib.request.Request(
+                url + "/prefill", data=body,
+                headers={"Content-Type": "application/json", **headers})
+            with urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s) as resp:
+                resp.read()
+        except (OSError, urllib.error.URLError) as e:
+            router.prefill_complete(name, tokens, ok=False)
+            obs_events.emit(
+                "router", "prefill_failed", level="warn",
+                prefill_replica=name, error=str(e)[:120])
+            return None
+        router.prefill_complete(name, tokens, ok=True)
+        return url
 
     def _build_server(self, host: str, port: int):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -230,6 +282,13 @@ class RoutingGateway:
                     k: v for k, v in self.headers.items()
                     if k.lower() not in _HOP_HEADERS
                 }
+                kv_source = None
+                if decision.prefill_replica:
+                    kv_source = gateway._phase1_prefill(
+                        decision, body, headers)
+                    if kv_source:
+                        req["kv_source"] = kv_source
+                        body = json.dumps(req).encode()
                 tried = {decision.replica}
                 replica = decision.replica
                 while True:
@@ -258,6 +317,21 @@ class RoutingGateway:
                             })
                             return
                         replica = decision.replica
+                        if decision.prefill_replica:
+                            if kv_source is None:
+                                kv_source = gateway._phase1_prefill(
+                                    decision, body, headers)
+                                if kv_source:
+                                    req["kv_source"] = kv_source
+                                    body = json.dumps(req).encode()
+                            else:
+                                # phase 1 already ran; the chain still
+                                # lives at kv_source — just release the
+                                # re-stamped prefill tokens
+                                router.prefill_complete(
+                                    decision.prefill_replica,
+                                    max(0, decision.prompt_tokens
+                                        - decision.overlap_tokens))
                         tried.add(replica)
                         router.m_retries.inc()
                         obs_events.emit(

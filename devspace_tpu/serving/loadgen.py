@@ -89,9 +89,54 @@ def _round(x: float) -> float:
     return round(float(x), 6)
 
 
+def load_recorded_trace(path: str) -> list:
+    """Parse a recorded JSONL trace: one request per line carrying
+    ``timestamp`` (seconds; absolute or already-relative — arrivals are
+    re-based so the earliest is 0), ``prompt`` (token ids; ``prompt_ids``
+    also accepted) and optionally ``tenant`` / ``max_new_tokens`` /
+    ``sampled`` / ``session``. The result uses the exact
+    :func:`generate_trace` event schema, so replay, verification and
+    :func:`trace_json` byte-stability work unchanged on recorded
+    production traffic."""
+    events: list = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                at = float(rec.get("timestamp", rec.get("at", 0.0)))
+                ids = [int(t) for t in
+                       rec.get("prompt", rec.get("prompt_ids"))]
+            except (TypeError, ValueError, json.JSONDecodeError) as e:
+                raise ValueError(
+                    f"{path}:{lineno}: bad trace record: {e}") from None
+            events.append({
+                "id": len(events),
+                "at": at,
+                "prompt_ids": ids,
+                "max_new_tokens": int(rec.get("max_new_tokens", 16)),
+                "sampled": bool(rec.get("sampled", False)),
+                "session": int(rec.get("session", -1)),
+                "tenant": str(rec.get("tenant", "")),
+            })
+    if not events:
+        raise ValueError(f"{path}: empty trace file")
+    base = min(e["at"] for e in events)
+    for e in events:
+        e["at"] = _round(e["at"] - base)
+    events.sort(key=lambda e: (e["at"], e["id"]))
+    return events
+
+
 def generate_trace(spec: TraceSpec) -> list:
     """[{id, at, prompt_ids, max_new_tokens, sampled, session}] sorted
-    by arrival time. Pure function of ``spec``."""
+    by arrival time. Pure function of ``spec`` — including
+    ``kind="file:<path>.jsonl"``, which replays a recorded trace (same
+    bytes in, same trace out)."""
+    if spec.kind.startswith("file:"):
+        return load_recorded_trace(spec.kind[len("file:"):])
     rng = random.Random(spec.seed)
     events: list = []
 
@@ -288,6 +333,7 @@ class LoadGenerator:
             "max_new_tokens": n,
             "stream": True,
             "sampled": event.get("sampled", False),
+            "tenant": event.get("tenant", ""),
         }).encode()
         req = urllib.request.Request(
             url + "/generate", data=body,

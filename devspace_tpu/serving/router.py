@@ -59,6 +59,19 @@ queues (the gateway re-polls until capacity or ``queue_timeout_s``),
 at/above ``breach_burn`` rejects immediately — shedding the load an
 FIFO queue would silently convert into timeout pain.
 
+**Two-phase placement** (disaggregated prefill/decode). With
+``disagg_threshold_tokens > 0``, a request whose uncached prompt span
+reaches the threshold — or whose decode target sits at/above
+``disagg_occupancy_band`` occupancy — gets a second verdict field:
+``prefill_replica``, the least-prefill-loaded candidate (preferring the
+dedicated ``prefill_pool``, whose members never take decode streams
+while anything else is routable). The gateway prefills there first,
+then sends the decode request with ``kv_source`` so the decode replica
+pulls the KV chain (:mod:`devspace_tpu.inference.kv_tier` wire format)
+instead of recomputing a long prefill in its decode batch. Phase-1
+failures degrade to unified placement — the decode replica simply
+prefills locally.
+
 Policies: ``prefix`` (the full blend), ``least_loaded`` (load term
 only), ``round_robin`` (cycle — the A/B baseline). All three share
 admission and bookkeeping, so the bench compares routing policy alone.
@@ -115,6 +128,17 @@ SERVING_ROUTER_METRIC_FAMILIES = (
      "Requests currently proxied through this gateway", "sum"),
     ("serving_router_shadow_blocks", "gauge",
      "Block digests tracked across all replica shadow indexes", "sum"),
+    ("serving_router_prefill_dispatches_total", "counter",
+     "Requests placed two-phase: prefill on one replica, decode on "
+     "another", "sum"),
+    ("serving_router_prefill_tokens_total", "counter",
+     "Uncached prompt tokens sent to a separate prefill replica", "sum"),
+    ("serving_router_prefill_failures_total", "counter",
+     "Phase-1 prefill calls that failed (request degraded to unified "
+     "placement)", "sum"),
+    ("serving_router_prefill_inflight_tokens", "gauge",
+     "Prompt tokens currently prefilling on behalf of other replicas",
+     "sum"),
 )
 
 
@@ -238,6 +262,16 @@ class RouterConfig:
     queue_timeout_s: float = 5.0
     default_service_s: float = 0.2
     service_ewma: float = 0.2      # weight of the newest observation
+    # Disaggregated prefill/decode (two-phase placement). 0 disables.
+    # A request whose UNCACHED prompt span reaches the threshold — or
+    # whose decode target's occupancy is at/above the band — prefills on
+    # the least-prefill-loaded replica first; the decode target then
+    # pulls the KV chain (engine ``kv_source``). ``prefill_pool`` names
+    # replicas reserved for prefill: they are excluded from decode
+    # candidacy while any other replica is routable.
+    disagg_threshold_tokens: int = 0
+    disagg_occupancy_band: float = 0.85
+    prefill_pool: tuple = ()
 
     def validate(self) -> None:
         if self.policy not in ROUTE_POLICIES:
@@ -250,6 +284,10 @@ class RouterConfig:
             raise ValueError("breach_burn must be >= warn_burn")
         if self.target_ttft_s <= 0:
             raise ValueError("target_ttft_s must be > 0")
+        if self.disagg_threshold_tokens < 0:
+            raise ValueError("disagg_threshold_tokens must be >= 0")
+        if not 0.0 < self.disagg_occupancy_band:
+            raise ValueError("disagg_occupancy_band must be > 0")
 
 
 @dataclass
@@ -266,6 +304,9 @@ class RoutingDecision:
     projected_ttft_s: float = 0.0
     scores: dict = field(default_factory=dict)  # name -> blended score
     reason: str = ""
+    # Two-phase placement: when set, the gateway prefills there first
+    # and the decode replica pulls the KV chain (``kv_source``).
+    prefill_replica: Optional[str] = None
 
 
 class PrefixRouter:
@@ -297,6 +338,7 @@ class PrefixRouter:
         self._inflight: dict = {}       # name -> int
         self._service_s: dict = {}      # name -> EWMA seconds
         self._fair: dict = {}           # name -> deque[tenant]
+        self._prefill_tokens: dict = {}  # name -> in-flight prefill toks
         self._decisions = deque(maxlen=128)  # recent dicts for /debug
 
         self.registry = registry or Registry()
@@ -316,6 +358,12 @@ class PrefixRouter:
         self.m_hit_tokens = counter(
             "serving_router_expected_hit_tokens_total")
         self.m_prompt_tokens = counter("serving_router_prompt_tokens_total")
+        self.m_prefill_dispatches = counter(
+            "serving_router_prefill_dispatches_total")
+        self.m_prefill_tokens = counter(
+            "serving_router_prefill_tokens_total")
+        self.m_prefill_failures = counter(
+            "serving_router_prefill_failures_total")
         self.h_decision = reg.histogram(
             "serving_router_decision_seconds",
             fams["serving_router_decision_seconds"][2])
@@ -330,6 +378,10 @@ class PrefixRouter:
             "serving_router_shadow_blocks", "gauge",
             fams["serving_router_shadow_blocks"][2],
             self.shadow.total_blocks)
+        reg.register_callback(
+            "serving_router_prefill_inflight_tokens", "gauge",
+            fams["serving_router_prefill_inflight_tokens"][2],
+            lambda: sum(self._prefill_tokens.values()))
 
     # -- load view -----------------------------------------------------------
     def _effective_load(self, name: str, loads: dict) -> tuple:
@@ -376,17 +428,24 @@ class PrefixRouter:
         request already failed on)."""
         t0 = self._clock()
         cfg = self.config
-        replicas = sorted(
+        routable = sorted(
             n for n in self.replicas_fn() if n not in exclude)
-        if not replicas:
+        if not routable:
             return RoutingDecision(
                 admission=REJECT, reason="no routable replicas")
+        # Dedicated prefill-pool replicas never take decode streams while
+        # any other replica is routable (they would pin long prefills
+        # behind decodes); the pool degrades to full candidacy when it is
+        # all that's left.
+        replicas = [n for n in routable if n not in cfg.prefill_pool] \
+            or routable
         chain = fingerprint_chain(prompt_ids, cfg.block_size) \
             if cfg.policy == "prefix" else []
         loads = self.loads_fn() or {}
         with self._lock:
             decision = self._route_locked(
-                replicas, chain, len(prompt_ids), tenant, loads, stamp)
+                replicas, routable, chain, len(prompt_ids), tenant,
+                loads, stamp)
         if stamp:
             self.h_decision.observe(max(0.0, self._clock() - t0))
             if decision.admission == ADMIT:
@@ -400,6 +459,18 @@ class PrefixRouter:
                         replica=decision.replica,
                         overlap_tokens=decision.overlap_tokens,
                         reason=decision.reason,
+                    )
+                if decision.prefill_replica:
+                    self.m_prefill_dispatches.inc()
+                    self.m_prefill_tokens.inc(max(
+                        0, decision.prompt_tokens
+                        - decision.overlap_tokens))
+                    obs_events.emit(
+                        "router", "prefill_dispatched", level="info",
+                        replica=decision.replica,
+                        prefill_replica=decision.prefill_replica,
+                        prompt_tokens=decision.prompt_tokens,
+                        overlap_tokens=decision.overlap_tokens,
                     )
                 obs_events.emit(
                     "router", "request_routed", level="debug",
@@ -420,8 +491,8 @@ class PrefixRouter:
                 self.m_queued.inc()
         return decision
 
-    def _route_locked(self, replicas, chain, prompt_tokens, tenant,
-                      loads, stamp) -> RoutingDecision:
+    def _route_locked(self, replicas, routable, chain, prompt_tokens,
+                      tenant, loads, stamp) -> RoutingDecision:
         cfg = self.config
         overlaps = {}
         scores = {}
@@ -477,9 +548,41 @@ class PrefixRouter:
             projected_ttft_s=projected, scores=scores,
             reason=f"policy={cfg.policy}",
         )
+        decision.prefill_replica = self._pick_prefill_locked(
+            decision, chosen, routable, loads)
         if stamp:
             self._stamp_locked(decision, chain, tenant)
         return decision
+
+    def _pick_prefill_locked(self, decision, chosen, routable,
+                             loads) -> Optional[str]:
+        """Two-phase placement trigger + target. Fires when the uncached
+        prompt span reaches ``disagg_threshold_tokens`` (or the decode
+        target's occupancy is at/above ``disagg_occupancy_band``) and at
+        least one full block would migrate; the prefill target is the
+        least-prefill-loaded candidate, preferring the dedicated pool."""
+        cfg = self.config
+        if cfg.disagg_threshold_tokens <= 0:
+            return None
+        uncached = decision.prompt_tokens - decision.overlap_tokens
+        if uncached < cfg.block_size:
+            return None  # nothing worth migrating
+        sig = loads.get(chosen) or ReplicaLoad()
+        if (uncached < cfg.disagg_threshold_tokens
+                and sig.occupancy < cfg.disagg_occupancy_band):
+            return None
+        pool = [n for n in routable
+                if n in cfg.prefill_pool and n != chosen]
+        candidates = pool or [n for n in routable if n != chosen]
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda n: (
+                self._prefill_tokens.get(n, 0),
+                self._effective_load(n, loads)[0],
+                n,
+            ))
 
     def _stamp_locked(self, decision, chain, tenant) -> None:
         cfg = self.config
@@ -490,12 +593,24 @@ class PrefixRouter:
         window = self._fair.setdefault(
             name, deque(maxlen=cfg.fairness_window))
         window.append(tenant)
+        if decision.prefill_replica:
+            pre = decision.prefill_replica
+            uncached = max(0, decision.prompt_tokens
+                           - decision.overlap_tokens)
+            self._prefill_tokens[pre] = (
+                self._prefill_tokens.get(pre, 0) + uncached)
+            if cfg.policy == "prefix":
+                # the prefill replica's radix cache holds the prompt
+                # chain after phase 1 — teach the shadow index so a
+                # repeat prompt can decode there directly
+                self.shadow.observe(pre, chain)
         self._decisions.append({
             "replica": name,
             "tenant": tenant,
             "overlap_tokens": decision.overlap_tokens,
             "prompt_tokens": decision.prompt_tokens,
             "spilled": decision.spilled,
+            "prefill_replica": decision.prefill_replica,
             "projected_ttft_s": round(decision.projected_ttft_s, 4),
         })
 
@@ -528,6 +643,20 @@ class PrefixRouter:
                     (1 - cfg.service_ewma) * prev
                     + cfg.service_ewma * service_s)
 
+    def prefill_complete(self, replica: str, tokens: int,
+                         ok: bool = True) -> None:
+        """Phase 1 of a two-phase placement reached a terminal outcome:
+        release the replica's in-flight prefill tokens; a failure also
+        counts (the gateway degraded the request to unified placement)."""
+        with self._lock:
+            n = self._prefill_tokens.get(replica, 0) - max(0, tokens)
+            if n > 0:
+                self._prefill_tokens[replica] = n
+            else:
+                self._prefill_tokens.pop(replica, None)
+        if not ok:
+            self.m_prefill_failures.inc()
+
     def forget_replica(self, name: str) -> None:
         """Drop a replica's shadow/fairness state (it died or scaled
         away — its radix cache died with it)."""
@@ -536,6 +665,7 @@ class PrefixRouter:
             self._fair.pop(name, None)
             self._inflight.pop(name, None)
             self._service_s.pop(name, None)
+            self._prefill_tokens.pop(name, None)
 
     # -- introspection -------------------------------------------------------
     def stats(self) -> dict:
@@ -543,6 +673,7 @@ class PrefixRouter:
             return {
                 "policy": self.config.policy,
                 "inflight": dict(self._inflight),
+                "prefill_tokens": dict(self._prefill_tokens),
                 "service_s": {
                     k: round(v, 4) for k, v in self._service_s.items()},
                 "shadow_blocks": {

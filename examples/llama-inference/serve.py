@@ -39,6 +39,12 @@ CLI: --kv-tier off|host|host+disk spills evicted prefix chains to host
 RAM (optionally overflowing to disk) and restores them on radix hits
 instead of recomputing prefill (docs/inference.md "KV tiering"). The
 DEVSPACE_KV_TIER env var is the fallback when the flag is omitted.
+
+Disaggregated prefill/decode (docs/serving.md): POST /prefill runs a
+prompt's prefill so its KV chain lands in the radix cache; GET
+/kv/chain/<digest> exports that chain as a checksummed wire envelope;
+a "kv_source" field on /generate makes this replica pull the chain
+from the named peer instead of recomputing the prefill.
 """
 
 import json
@@ -493,6 +499,25 @@ def main(argv=None):
                         "requests": rows[-max(0, limit):] if limit else [],
                     },
                 )
+            elif path.startswith("/kv/chain/"):
+                # disaggregated prefill/decode: serve this replica's KV
+                # chain (root->leaf, versioned + checksummed envelope,
+                # devspace_tpu.inference.kv_tier) so a decode replica can
+                # pull migrated blocks instead of recomputing prefill.
+                digest = path[len("/kv/chain/"):]
+                try:
+                    envelope = server.engine.export_kv_chain(digest)
+                except Exception:  # noqa: BLE001 — a failed export is a miss
+                    envelope = None
+                if envelope is None:
+                    self._json(404, {"error": "unknown chain digest"})
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(envelope)))
+                self.end_headers()
+                self.wfile.write(envelope)
             elif path == "/debug/trace":
                 # On-demand timeline capture: record the engine's scheduler
                 # iterations, overlapped decode dispatches, readback waits
@@ -584,6 +609,26 @@ def main(argv=None):
                 except Exception:  # noqa: BLE001
                     self._json(500, {"error": "internal server error"})
                 return
+            if self.path == "/prefill":
+                # phase 1 of two-phase placement: run the prompt through
+                # the engine (one decode step) so its KV chain lands in
+                # the radix cache, ready to be exported to the decode
+                # replica via /kv/chain/<digest>
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    prompt = [int(t) for t in req["prompt_ids"]]
+                    server.engine.submit(
+                        prompt, 1,
+                        traceparent=self.headers.get("traceparent"),
+                    ).result(timeout=600)
+                    self._json(200, {"prefilled_tokens": len(prompt)})
+                except (ValueError, KeyError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e)})
+                except Exception:  # noqa: BLE001
+                    self._json(500, {"error": "internal server error"})
+                return
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
                 return
@@ -607,6 +652,14 @@ def main(argv=None):
                     # W3C trace context: the request's serving spans join
                     # the caller's distributed trace when present
                     traceparent=self.headers.get("traceparent"),
+                    # disaggregated placement: the gateway prefilled this
+                    # prompt on another replica; pull its KV chain from
+                    # there instead of recomputing (failures degrade to
+                    # local recompute-prefill inside the engine)
+                    kv_source=(
+                        str(req["kv_source"])
+                        if req.get("kv_source") else None
+                    ),
                 )
                 prompt = req["prompt_ids"]
                 n = int(req.get("max_new_tokens", 16))

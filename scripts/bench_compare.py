@@ -45,6 +45,18 @@ INFORMATIONAL = {
     "router_round_robin_p50_ttft_ms",
     "router_round_robin_p99_ttft_ms",
     "router_round_robin_hit_tokens_per_request",
+    # disagg A/B: the unified arm is the baseline side, and the
+    # migration volume describes the workload; the gated disagg_* keys
+    # are the disaggregated arm's TTFT/tok-s and the two ratios
+    "disagg_requests",
+    "disagg_short_requests",
+    "disagg_unified_short_p50_ttft_ms",
+    "disagg_unified_short_p99_ttft_ms",
+    "disagg_unified_tok_per_sec",
+    "disagg_prefill_dispatches",
+    "disagg_migrated_chains",
+    "disagg_migrated_kb",
+    "disagg_recompute_fallbacks",
 }
 
 # non-numeric context keys, never compared

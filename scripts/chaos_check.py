@@ -75,6 +75,16 @@ REQUIRED_CHAOS_MODULES = (
     # outcomes — the gateway never replays bytes into a half-written
     # client stream
     "test_serving_router",
+    # KV-block migration degradation ladder (ISSUE 20): a dead source
+    # and a corrupted chain envelope must both end in recompute-prefill
+    # with byte-identical output and matching failure/fallback counters
+    # — a partial migration is never scattered into the pool
+    "test_kv_migrate",
+    # disaggregated prefill/decode (ISSUE 20): SIGKILLing the dedicated
+    # prefill-pool replica under mixed short+long load must degrade
+    # every orphaned migration to unified placement or recompute with
+    # zero corrupted and zero hung client streams
+    "test_serving_disagg",
 )
 
 

@@ -1,6 +1,6 @@
 """Serving chaos gate: composed failure weather over a live replica fleet.
 
-Five scenarios, each against a real (stub-replica) fleet with real
+Six scenarios, each against a real (stub-replica) fleet with real
 subprocesses, sockets and streams — run ``--repeats`` times (default 3)
 so a flaky pass can't sneak through:
 
@@ -26,6 +26,15 @@ so a flaky pass can't sneak through:
    gateway must reroute with zero corrupted and zero hung streams, the
    fleet must return to all-healthy, and a post-recovery wave's p99
    TTFT must re-converge to the healthy baseline.
+6. **disagg-kill-prefill** — mixed short-chat + long-RAG traffic flows
+   through a gateway running two-phase placement with a dedicated
+   prefill-pool replica; SIGKILL that replica mid-migration. Every
+   orphaned migration must degrade — unified placement or
+   recompute-prefill — with zero corrupted and zero hung streams, the
+   decode replicas' ``engine_kv_restore_fallbacks_total`` must exactly
+   match their migration failures (no silent partial scatters), the
+   router's in-flight prefill accounting must drain, and the fleet
+   returns to all-healthy.
 
 Usage:
     python scripts/chaos_serving_check.py [--repeats N] [--scenario NAME]
@@ -315,12 +324,107 @@ def scenario_router_kill_prefix_hot() -> dict:
         fleet.stop()
 
 
+def scrape_metric(base_url: str, name: str) -> float:
+    import urllib.request
+
+    with urllib.request.urlopen(base_url + "/metrics", timeout=5) as resp:
+        text = resp.read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def scenario_disagg_kill_prefill() -> dict:
+    from devspace_tpu.serving.gateway import RoutingGateway
+    from devspace_tpu.serving.router import PrefixRouter, RouterConfig
+
+    fleet = ReplicaFleet(
+        spec=fast_spec(STUB_TOKEN_DELAY_S="0.01",
+                       STUB_PREFILL_DELAY_PER_TOKEN_S="0.002"),
+        replicas=3, poll_interval=0.1)
+    fleet.start()
+    gw = None
+    try:
+        pool = "replica-2"
+        router = PrefixRouter(
+            replicas_fn=fleet.targets,
+            # admission off: the gate's invariants are degrade-on-death,
+            # and outcomes must repeat exactly across --repeats
+            config=RouterConfig(admission=False, prefill_pool=(pool,),
+                                disagg_threshold_tokens=32))
+        gw = RoutingGateway(router, port=0)
+        gw.start()
+
+        # mixed weather: short chat turns interleaved with long RAG
+        # prompts whose fresh contexts each take the two-phase path
+        trace = generate_trace(TraceSpec(
+            seed=31, kind="rag", duration_s=2.5, rate_rps=10,
+            rag_contexts=4, rag_context_len=(96, 128),
+            rag_long_fraction=0.5, max_new_tokens=(12, 24)))
+        gen = LoadGenerator(
+            lambda: {"gw": gw.base_url}, request_timeout_s=15,
+            hang_timeout_s=30, max_attempts=4)
+        import threading
+
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.__setitem__("report", gen.run(trace)),
+            daemon=True)
+        th.start()
+        # SIGKILL the pool replica the moment migrations are in flight
+        wait_for(
+            lambda: any(d.get("prefill_replica")
+                        for d in router.stats()["recent_decisions"]),
+            20, "first two-phase placement")
+        fleet.kill(pool)
+        th.join(timeout=90)
+        check(not th.is_alive(), "disagg loadgen did not finish")
+        report = box["report"]
+        counts = report.counts()
+        check(len(report.outcomes) == len(trace),
+              f"unresolved requests: {len(report.outcomes)}/{len(trace)}")
+        check(counts["corrupted"] == 0, f"corrupted streams: {counts}")
+        check(counts["hung"] == 0, f"hung requests: {counts}")
+        check(counts["failed"] == 0, f"failed requests: {counts}")
+        snap = router.registry.snapshot()
+        dispatches = int(
+            snap["serving_router_prefill_dispatches_total"]["samples"][0][1])
+        check(dispatches >= 1, "no two-phase placement ever fired")
+        wait_for(lambda: router.stats()["prefill_tokens"] == {}, 20,
+                 "in-flight prefill accounting to drain")
+        wait_for(fleet.all_healthy, 20, "fleet recovery after pool kill")
+        # degrade accounting: every failed migration on a decode replica
+        # counted exactly one recompute fallback — nothing scattered
+        # partially, nothing silently retried into corruption. (The
+        # restarted pool replica reports fresh zeros; summing it is a
+        # no-op.)
+        failures = fallbacks = 0
+        for name, url in sorted(fleet.targets().items()):
+            failures += scrape_metric(url, "engine_kv_migrate_failures_total")
+            fallbacks += scrape_metric(url, "engine_kv_restore_fallbacks_total")
+        check(failures == fallbacks,
+              f"migration failures ({failures}) != recompute fallbacks "
+              f"({fallbacks}): a failed migration was not degraded cleanly")
+        prefill_failures = int(
+            snap["serving_router_prefill_failures_total"]["samples"][0][1])
+        return {"counts": counts, "prefill_dispatches": dispatches,
+                "phase1_failures": prefill_failures,
+                "migrate_failures": int(failures),
+                "recompute_fallbacks": int(fallbacks)}
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
 SCENARIOS = {
     "kill-mid-stream": scenario_kill_mid_stream,
     "hang-replica": scenario_hang_replica,
     "metrics-garbage": scenario_metrics_garbage,
     "burst-then-idle": scenario_burst_then_idle,
     "router-kill-prefix-hot": scenario_router_kill_prefix_hot,
+    "disagg-kill-prefill": scenario_disagg_kill_prefill,
 }
 
 

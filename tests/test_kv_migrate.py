@@ -1,0 +1,310 @@
+"""KV-block migration suite (ISSUE 20): disaggregated prefill/decode.
+
+Three layers, mirroring tests/test_kv_tier.py:
+
+- **Wire format.** A chain envelope round-trips bit-exactly between two
+  host tiers in different "processes" (independent tier objects — the
+  bytes ARE the process boundary), and every tamper mode is a clean
+  :class:`WireFormatError`: truncation, a single flipped bit anywhere,
+  and version skew (a v2 envelope with a RECOMPUTED trailer, so the
+  version check itself is exercised, not shadowed by the checksum).
+
+- **Fetch client.** A 404-at-source fails fast as
+  :class:`KVMigrateError` (no pointless retries against a replica that
+  no longer holds the chain); transient transport errors retry under
+  the resilience policy and succeed.
+
+- **Engine equivalence.** Decode on engine B with ``kv_source`` pulling
+  engine A's chain must produce byte-identical streams to a cold local
+  prefill — migration is a pure optimization. The chaos-marked cases
+  (registered in scripts/chaos_check.py) pin the degradation ladder:
+  a dead source and a corrupted envelope must both end in
+  recompute-prefill with ``kv_migrate_failures`` /
+  ``kv_restore_fallbacks`` accounting and zero remote nodes left in
+  the radix tree — never a corrupted or hung stream.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from devspace_tpu.inference import InferenceEngine
+from devspace_tpu.inference.kv_tier import (
+    _WIRE_VERSION,
+    HostKVTier,
+    KVMigrateError,
+    KVMigrationClient,
+    WireFormatError,
+    _checksum,
+    export_chain,
+    import_chain,
+    pack_chain_envelope,
+    pack_kv_payload,
+    unpack_chain_envelope,
+    unpack_kv_payload,
+)
+from devspace_tpu.models import transformer as tfm
+from devspace_tpu.resilience.policy import RetryPolicy
+
+CFG = tfm.TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _payload(seed=0, shape=(2, 2, 4, 8)):
+    rng = np.random.default_rng(seed)
+    kq = rng.integers(-127, 128, size=shape).astype(np.int8)
+    vq = rng.integers(-127, 128, size=shape).astype(np.int8)
+    ks = rng.random(shape[:3], dtype=np.float32)
+    vs = rng.random(shape[:3], dtype=np.float32)
+    return pack_kv_payload(kq, ks, vq, vs)
+
+
+def _chain(n=3):
+    return [(f"digest-{i:02d}" + "ab" * 8, _payload(seed=i))
+            for i in range(n)]
+
+
+# -- wire format -------------------------------------------------------------
+def test_envelope_roundtrip_is_bit_exact():
+    blocks = _chain(4)
+    out = unpack_chain_envelope(pack_chain_envelope(blocks))
+    assert [d for d, _ in out] == [d for d, _ in blocks]
+    for (_, a), (_, b) in zip(blocks, out):
+        assert a == b  # byte equality, not just array equality
+
+
+def test_cross_process_round_trip_bit_exact_pools():
+    """Two independent tiers = two processes; the envelope is the only
+    thing that crosses. Unpacked int8 pools must match bit-for-bit."""
+    src, dst = HostKVTier(max_bytes=1 << 20), HostKVTier(max_bytes=1 << 20)
+    blocks = _chain(3)
+    for digest, payload in blocks:
+        src.put(digest, payload)
+    envelope = export_chain(src, [d for d, _ in blocks])
+    assert envelope is not None
+    imported = import_chain(dst, envelope)
+    assert imported == [d for d, _ in blocks]
+    for digest, payload in blocks:
+        got = dst.get(digest)
+        assert got == payload
+        for a, b in zip(unpack_kv_payload(got), unpack_kv_payload(payload)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_export_chain_refuses_partial():
+    tier = HostKVTier(max_bytes=1 << 20)
+    blocks = _chain(3)
+    for digest, payload in blocks[:-1]:  # leaf missing
+        tier.put(digest, payload)
+    assert export_chain(tier, [d for d, _ in blocks]) is None
+    assert export_chain(tier, []) is None
+
+
+def test_truncated_envelope_rejected():
+    envelope = pack_chain_envelope(_chain(2))
+    for cut in (1, 8, len(envelope) // 2, len(envelope) - 1):
+        with pytest.raises(WireFormatError):
+            unpack_chain_envelope(envelope[:cut])
+
+
+def test_bit_flip_rejected_everywhere():
+    """Flipping any single byte — magic, digest, payload, length field,
+    trailer — must raise, never return altered blocks."""
+    envelope = pack_chain_envelope(_chain(2))
+    step = max(1, len(envelope) // 37)  # sample positions across it
+    for pos in range(0, len(envelope), step):
+        bad = (envelope[:pos]
+               + bytes([envelope[pos] ^ 0x40])
+               + envelope[pos + 1:])
+        with pytest.raises(WireFormatError):
+            unpack_chain_envelope(bad)
+
+
+def test_version_skew_rejected_cleanly():
+    """A future-version envelope with a VALID trailer (recomputed over
+    the modified body) is rejected by the version check itself."""
+    envelope = pack_chain_envelope(_chain(1))
+    body = bytearray(envelope[:-len(_checksum(b""))])
+    assert body[4] == _WIRE_VERSION
+    body[4] = _WIRE_VERSION + 8
+    skewed = bytes(body) + _checksum(bytes(body))
+    with pytest.raises(WireFormatError, match="version"):
+        unpack_chain_envelope(skewed)
+
+
+def test_envelope_trailing_bytes_rejected():
+    envelope = pack_chain_envelope(_chain(1))
+    with pytest.raises(WireFormatError):
+        unpack_chain_envelope(envelope + b"xx")
+
+
+# -- fetch client ------------------------------------------------------------
+def test_client_404_fails_fast_no_retry():
+    calls = []
+
+    def fetch(source, digest):
+        calls.append(digest)
+        raise KVMigrateError("gone at source")
+
+    client = KVMigrationClient(fetch_fn=fetch)
+    with pytest.raises(KVMigrateError):
+        client.fetch("http://peer", "deadbeef")
+    assert len(calls) == 1  # non-retryable: exactly one attempt
+
+
+def test_client_retries_transient_then_succeeds():
+    calls = []
+
+    def fetch(source, digest):
+        calls.append(source)
+        if len(calls) < 3:
+            raise OSError("connection reset")
+        return b"the-envelope"
+
+    client = KVMigrationClient(
+        retry=RetryPolicy(max_attempts=3, base_delay=0.001,
+                          retry_on=(OSError,), seed=0),
+        fetch_fn=fetch)
+    assert client.fetch("http://peer", "d0") == b"the-envelope"
+    assert len(calls) == 3
+
+
+# -- engine-level migration --------------------------------------------------
+PROMPT = [(7 * i) % 49 + 1 for i in range(40)]  # 4 full blocks at bs=8
+N_NEW = 8
+
+
+def _mk_engine(params, **kw):
+    defaults = dict(max_slots=2, max_len=64, block_size=8, n_blocks=10,
+                    prefill_chunk=8, chunk_max=4)
+    defaults.update(kw)
+    return InferenceEngine(params, CFG, kv_tier="host", **defaults)
+
+
+@pytest.fixture(scope="module")
+def baseline(params):
+    """Cold local prefill+decode — the equivalence reference."""
+    engine = _mk_engine(params).start()
+    try:
+        return engine.submit(PROMPT, N_NEW).result(timeout=600)
+    finally:
+        engine.stop()
+
+
+def _exporting_fetch(source_engine):
+    def fetch(source, digest):
+        envelope = source_engine.export_kv_chain(digest)
+        if envelope is None:
+            raise KVMigrateError(f"no chain {digest[:16]} at source")
+        return envelope
+    return fetch
+
+
+def test_engine_migration_is_byte_identical(params, baseline):
+    """A -> B chain migration: B's decode output must equal a cold local
+    prefill, with the migrate counters proving the pull happened."""
+    a = _mk_engine(params).start()
+    b = _mk_engine(params).start()
+    try:
+        assert a.submit(PROMPT, N_NEW).result(timeout=600) == baseline
+        b._kv_client = KVMigrationClient(fetch_fn=_exporting_fetch(a))
+        tokens = b.submit(
+            PROMPT, N_NEW, kv_source="engine-a").result(timeout=600)
+        st = b.stats()
+    finally:
+        a.stop()
+        b.stop()
+    assert tokens == baseline
+    assert st["kv_migrate_chains"] == 1
+    assert st["kv_migrate_blocks"] == 4
+    assert st["kv_migrate_bytes"] > 0
+    assert st["kv_migrate_failures"] == 0
+    assert st["kv_tier_remote_nodes"] == 0  # all promoted + restored
+    assert a.stats()["kv_export_chains"] == 1
+
+
+def test_kv_source_ignored_when_chain_already_local(params, baseline):
+    """A replica that already holds the prefix must not fetch at all."""
+    calls = []
+
+    def fetch(source, digest):
+        calls.append(digest)
+        raise AssertionError("must not fetch")
+
+    engine = _mk_engine(params).start()
+    try:
+        engine._kv_client = KVMigrationClient(fetch_fn=fetch)
+        assert engine.submit(PROMPT, N_NEW).result(timeout=600) == baseline
+        again = engine.submit(
+            PROMPT, N_NEW, kv_source="http://peer").result(timeout=600)
+    finally:
+        engine.stop()
+    assert again == baseline
+    assert calls == []
+
+
+# -- chaos (registered in scripts/chaos_check.py) ----------------------------
+@pytest.mark.chaos
+def test_dead_source_degrades_to_recompute(params, baseline):
+    """Every fetch attempt dies with a transport error: the request must
+    recompute prefill locally and stream byte-identical output, counting
+    one migrate failure and one restore fallback, leaving no remote
+    nodes behind."""
+    calls = []
+
+    def fetch(source, digest):
+        calls.append(digest)
+        raise OSError("connection refused")
+
+    b = _mk_engine(params).start()
+    try:
+        b._kv_client = KVMigrationClient(
+            retry=RetryPolicy(max_attempts=2, base_delay=0.001,
+                              retry_on=(OSError,), seed=0),
+            fetch_fn=fetch)
+        tokens = b.submit(
+            PROMPT, N_NEW, kv_source="http://dead").result(timeout=600)
+        st = b.stats()
+    finally:
+        b.stop()
+    assert tokens == baseline
+    assert len(calls) == 2  # retried once, then gave up
+    assert st["kv_migrate_chains"] == 0
+    assert st["kv_migrate_failures"] == 1
+    assert st["kv_restore_fallbacks"] >= 1
+    assert st["kv_tier_remote_nodes"] == 0  # pruned, not leaked
+
+
+@pytest.mark.chaos
+def test_corrupted_envelope_degrades_to_recompute(params, baseline):
+    """A bit-flipped envelope from a live source must be REJECTED by the
+    wire checksum and degrade to recompute — never scattered into the
+    pool (output stays byte-identical)."""
+    a = _mk_engine(params).start()
+    b = _mk_engine(params).start()
+    try:
+        assert a.submit(PROMPT, N_NEW).result(timeout=600) == baseline
+        real = _exporting_fetch(a)
+
+        def corrupting(source, digest):
+            envelope = real(source, digest)
+            mid = len(envelope) // 2
+            return (envelope[:mid] + bytes([envelope[mid] ^ 0xFF])
+                    + envelope[mid + 1:])
+
+        b._kv_client = KVMigrationClient(fetch_fn=corrupting)
+        tokens = b.submit(
+            PROMPT, N_NEW, kv_source="engine-a").result(timeout=600)
+        st = b.stats()
+    finally:
+        a.stop()
+        b.stop()
+    assert tokens == baseline
+    assert st["kv_migrate_chains"] == 0
+    assert st["kv_migrate_failures"] == 1
+    assert st["kv_restore_fallbacks"] >= 1
+    assert st["kv_tier_remote_nodes"] == 0

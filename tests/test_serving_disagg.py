@@ -1,0 +1,397 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 20).
+
+Three layers, mirroring tests/test_serving_router.py:
+
+- **Golden two-phase decision table.** Loads are injected and every
+  expected (decode, prefill) pair is hand-computed from
+  ``_pick_prefill_locked`` in devspace_tpu/serving/router.py: the
+  threshold and occupancy-band triggers, the one-full-block floor, pool
+  preference and exclusion-from-decode, least-prefill-loaded balancing,
+  and the ``prefill_complete`` token release.
+
+- **Gateway QUEUE re-poll backoff.** The re-poll wait is pinned against
+  a mirrored :class:`IdleBackoff` replay: unchanged projections double
+  the wait, a projection change snaps it back to ``queue_poll_s``.
+
+- **Live fleet.** Real stub subprocesses behind a real gateway: a long
+  prompt prefills on the pool replica and the decode replica pulls the
+  chain (``engine_kv_migrate_*`` on one side, ``engine_kv_export_*`` on
+  the other); a short prompt stays unified. The chaos-marked test
+  (registered in scripts/chaos_check.py) SIGKILLs the prefill-pool
+  replica under mixed short+long load and requires every stream to end
+  clean — orphaned migrations must degrade to recompute-prefill, never
+  corrupt or hang a client.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from devspace_tpu.resilience.policy import IdleBackoff
+from devspace_tpu.serving import ReplicaFleet, ReplicaSpec
+from devspace_tpu.serving.gateway import RoutingGateway
+from devspace_tpu.serving.loadgen import LoadGenerator
+from devspace_tpu.serving.router import (
+    ADMIT,
+    QUEUE,
+    PrefixRouter,
+    ReplicaLoad,
+    RouterConfig,
+)
+
+
+def counter_value(router, name: str) -> float:
+    fam = router.registry.snapshot().get(name)
+    if not fam or not fam["samples"]:
+        return 0.0
+    return float(fam["samples"][0][1])
+
+
+def make_router(replicas=("a", "b"), loads=None, **cfg_kw):
+    cfg_kw.setdefault("policy", "prefix")
+    loads = dict(loads or {})
+    return PrefixRouter(
+        replicas_fn=lambda: {n: f"http://{n}" for n in replicas},
+        loads_fn=lambda: loads,
+        config=RouterConfig(**cfg_kw),
+        clock=lambda: 0.0,
+    )
+
+
+LONG = list(range(40))   # 5 full blocks at block_size=8, all uncached
+SHORT = list(range(16))
+
+
+# -- golden two-phase decision table -----------------------------------------
+def test_disagg_off_by_default():
+    r = make_router()
+    d = r.route(LONG)
+    assert (d.admission, d.prefill_replica) == (ADMIT, None)
+    assert counter_value(r, "serving_router_prefill_dispatches_total") == 0
+
+
+def test_short_prompt_stays_unified():
+    r = make_router(disagg_threshold_tokens=32)
+    d = r.route(SHORT)  # 16 uncached < 32, occupancy 0 < 0.85
+    assert (d.replica, d.prefill_replica) == ("a", None)
+
+
+def test_long_prompt_prefills_on_pool_member():
+    r = make_router(replicas=("a", "b", "p0"),
+                    disagg_threshold_tokens=32, prefill_pool=("p0",))
+    d = r.route(LONG)
+    # decode ties break to "a" among non-pool replicas; prefill goes to
+    # the pool even though "b" is equally idle
+    assert (d.admission, d.replica, d.prefill_replica) == (ADMIT, "a", "p0")
+    assert counter_value(r, "serving_router_prefill_dispatches_total") == 1
+    assert counter_value(r, "serving_router_prefill_tokens_total") == 40
+    assert r.stats()["prefill_tokens"] == {"p0": 40}
+
+
+def test_threshold_is_exact_and_counts_uncached_only():
+    r = make_router(disagg_threshold_tokens=40)
+    # probe without stamping so the 39-token miss leaves no shadow state
+    assert r.route(list(range(39)), stamp=False).prefill_replica is None
+    d = r.route(LONG)                                        # 40 == 40
+    assert (d.replica, d.prefill_replica) == ("a", "b")
+    # the chain is now cached on BOTH a (decode) and b (prefill): the
+    # repeat prompt has 0 uncached tokens -> nothing worth migrating
+    again = r.route(LONG)
+    assert again.overlap_tokens == 40
+    assert again.prefill_replica is None
+
+
+def test_occupancy_band_triggers_below_threshold():
+    loads = {"a": ReplicaLoad(occupancy=0.9),
+             "b": ReplicaLoad(occupancy=0.9)}
+    r = make_router(loads=loads, disagg_threshold_tokens=64)
+    d = r.route(SHORT)  # 16 uncached < 64, but chosen occupancy >= 0.85
+    assert (d.replica, d.prefill_replica) == ("a", "b")
+    # under one full block there is nothing to migrate, band or not
+    d2 = r.route([1, 2, 3])
+    assert d2.prefill_replica is None
+
+
+def test_no_pool_picks_least_prefill_loaded_other():
+    r = make_router(replicas=("a", "b", "c"), disagg_threshold_tokens=32)
+    # three distinct long prompts; each decode target is the idlest by
+    # load, each prefill target the least-prefill-loaded non-chosen
+    d1 = r.route(list(range(100, 140)))
+    assert (d1.replica, d1.prefill_replica) == ("a", "b")
+    d2 = r.route(list(range(200, 240)))     # a busy -> decode b; b holds
+    assert (d2.replica, d2.prefill_replica) == ("b", "c")  # 40 prefill toks
+    d3 = r.route(list(range(300, 340)))     # a,b busy -> decode c;
+    assert (d3.replica, d3.prefill_replica) == ("c", "a")  # b,c loaded
+    assert r.stats()["prefill_tokens"] == {"a": 40, "b": 40, "c": 40}
+
+
+def test_pool_balances_by_inflight_prefill_tokens():
+    r = make_router(replicas=("a", "p0", "p1"),
+                    disagg_threshold_tokens=32,
+                    prefill_pool=("p0", "p1"))
+    d1 = r.route(list(range(100, 140)))
+    d2 = r.route(list(range(200, 240)))
+    assert (d1.replica, d2.replica) == ("a", "a")  # pool never decodes
+    assert (d1.prefill_replica, d2.prefill_replica) == ("p0", "p1")
+    # releasing p0's tokens makes it the idlest target again
+    r.prefill_complete("p0", 40)
+    assert r.stats()["prefill_tokens"] == {"p1": 40}
+    d3 = r.route(list(range(300, 340)))
+    assert d3.prefill_replica == "p0"
+
+
+def test_prefill_failure_counts_and_releases():
+    r = make_router(replicas=("a", "b"), disagg_threshold_tokens=32)
+    d = r.route(LONG)
+    assert d.prefill_replica == "b"
+    r.prefill_complete("b", 40, ok=False)
+    assert r.stats()["prefill_tokens"] == {}
+    assert counter_value(r, "serving_router_prefill_failures_total") == 1
+
+
+def test_pool_degrades_to_decode_when_nothing_else_routable():
+    r = make_router(replicas=("a", "p0"),
+                    disagg_threshold_tokens=32, prefill_pool=("p0",))
+    assert r.route(LONG).replica == "a"
+    d = r.route(LONG, exclude=frozenset({"a"}))
+    # the pool is all that's left: it takes the decode stream itself,
+    # and with no second replica there is no prefill target
+    assert (d.admission, d.replica, d.prefill_replica) == (ADMIT, "p0", None)
+
+
+def test_disagg_config_validation():
+    with pytest.raises(ValueError, match="disagg_threshold_tokens"):
+        RouterConfig(disagg_threshold_tokens=-1).validate()
+    with pytest.raises(ValueError, match="disagg_occupancy_band"):
+        RouterConfig(disagg_occupancy_band=0.0).validate()
+
+
+# -- gateway QUEUE re-poll backoff -------------------------------------------
+def test_queue_repoll_backoff_doubles_and_resets_on_projection_change():
+    """Pinned replay of the gateway's IdleBackoff re-poll: waits double
+    while the projection is unchanged (jitter from seed 0), and the
+    projection moving snaps the wait back to ``queue_poll_s``."""
+    loads = {"a": ReplicaLoad(queued=24, active=4, max_slots=4)}
+    # projected = (24+4)/4 * 0.2s = 1.4s -> warn band -> QUEUE
+    router = PrefixRouter(
+        replicas_fn=lambda: {"a": "http://a"},
+        loads_fn=lambda: dict(loads),
+        config=RouterConfig(),
+        clock=lambda: 0.0,
+    )
+    t = [0.0]
+    gw = RoutingGateway(router, port=0, clock=lambda: t[0])
+    try:
+        waits = []
+
+        def fake_sleep(s):
+            waits.append(s)
+            t[0] += s
+            if len(waits) == 3:   # projection 1.4 -> 2.0: reset expected
+                loads["a"] = ReplicaLoad(queued=36, active=4, max_slots=4)
+            elif len(waits) == 5:  # capacity freed -> ADMIT
+                loads["a"] = ReplicaLoad()
+
+        gw._sleep = fake_sleep
+        decision, wait = gw._admit(SHORT, tenant="")
+        assert decision.admission == ADMIT
+        assert wait == pytest.approx(sum(waits))
+        assert len(waits) == 5
+
+        # mirror the exact backoff the gateway builds; reset happens on
+        # the route AFTER the third sleep, i.e. before draw #4
+        mirror = IdleBackoff(
+            initial=gw.queue_poll_s,
+            maximum=max(gw.queue_poll_s,
+                        router.config.queue_timeout_s / 8),
+            jitter=0.5, seed=0)
+        expected = []
+        for i in range(1, 6):
+            expected.append(mirror.next_wait())
+            if i == 3:
+                mirror.reset()
+        assert waits == expected
+        # the shape the mirror proves: doubling, then the snap-back
+        assert waits[2] > waits[0]      # unchanged projection -> growth
+        assert waits[3] < waits[2]      # reset snapped to queue_poll_s
+    finally:
+        gw._httpd.server_close()
+
+
+def test_queue_repoll_times_out_to_reject():
+    loads = {"a": ReplicaLoad(queued=24, active=4, max_slots=4)}
+    router = PrefixRouter(
+        replicas_fn=lambda: {"a": "http://a"},
+        loads_fn=lambda: dict(loads),
+        config=RouterConfig(queue_timeout_s=0.5),
+        clock=lambda: 0.0,
+    )
+    t = [0.0]
+    gw = RoutingGateway(router, port=0, clock=lambda: t[0])
+    try:
+        gw._sleep = lambda s: t.__setitem__(0, t[0] + s)
+        decision, wait = gw._admit(SHORT, tenant="")
+        assert decision.admission != ADMIT
+        assert decision.admission != QUEUE
+        assert "queue timeout" in decision.reason
+        assert wait >= 0.5
+    finally:
+        gw._httpd.server_close()
+
+
+# -- live fleet --------------------------------------------------------------
+def wait_for(cond, timeout=20.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def fast_fleet(replicas=3, **env):
+    env.setdefault("STUB_TOKEN_DELAY_S", "0.002")
+    return ReplicaFleet(spec=ReplicaSpec(env=env), replicas=replicas,
+                        poll_interval=0.1)
+
+
+def make_gateway(fleet, **cfg_kw):
+    cfg_kw.setdefault("policy", "prefix")
+    router = PrefixRouter(replicas_fn=fleet.targets,
+                          config=RouterConfig(**cfg_kw))
+    gw = RoutingGateway(router, port=0)
+    gw.start()
+    return gw
+
+
+def gw_stream(gw, prompt, n):
+    body = json.dumps({"prompt_ids": prompt, "max_new_tokens": n,
+                       "stream": True}).encode()
+    req = urllib.request.Request(gw.base_url + "/generate", data=body)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return [json.loads(line) for line in resp]
+
+
+def replica_metric(url: str, name: str) -> float:
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def test_live_disagg_migrates_chain_and_keeps_stream_exact():
+    from devspace_tpu.serving.stub import token_at
+
+    fleet = fast_fleet(replicas=3)
+    fleet.start()
+    gw = None
+    try:
+        gw = make_gateway(fleet, prefill_pool=("replica-2",),
+                          disagg_threshold_tokens=32)
+        prompt = list(range(96))
+        lines = gw_stream(gw, prompt, 5)
+        assert [m["token"] for m in lines[:-1]] == [
+            token_at(prompt, i) for i in range(5)]
+        assert lines[-1] == {"done": True}
+        decisions = gw.router.stats()["recent_decisions"]
+        d = decisions[-1]
+        assert d["prefill_replica"] == "replica-2"
+        assert d["replica"] in ("replica-0", "replica-1")
+        targets = fleet.targets()
+        # prefill side exported the chain; decode side pulled it whole
+        assert replica_metric(
+            targets["replica-2"], "engine_kv_export_chains_total") >= 1
+        decode_url = targets[d["replica"]]
+        assert replica_metric(
+            decode_url, "engine_kv_migrate_chains_total") >= 1
+        assert replica_metric(
+            decode_url, "engine_kv_migrate_bytes_total") > 0
+        assert replica_metric(
+            decode_url, "engine_kv_migrate_failures_total") == 0
+        # a short prompt stays unified and off the pool
+        short_lines = gw_stream(gw, SHORT, 3)
+        assert [m["token"] for m in short_lines[:-1]] == [
+            token_at(SHORT, i) for i in range(3)]
+        d2 = gw.router.stats()["recent_decisions"][-1]
+        assert d2["prefill_replica"] is None
+        assert d2["replica"] != "replica-2"
+        # phase-1 accounting drains once the streams complete
+        wait_for(lambda: gw.router.stats()["prefill_tokens"] == {},
+                 msg="prefill tokens drained")
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()
+
+
+@pytest.mark.chaos
+def test_prefill_pool_replica_killed_mid_migration_degrades_clean():
+    """SIGKILL the dedicated prefill replica while mixed short+long load
+    is in flight. Long requests whose phase-1 or chain pull lands on the
+    corpse must degrade — unified placement or recompute-prefill — with
+    ZERO corrupted and ZERO hung client streams; decode replicas never
+    scatter a partial migration into their pools."""
+    fleet = fast_fleet(replicas=3, STUB_TOKEN_DELAY_S="0.01",
+                       STUB_PREFILL_DELAY_PER_TOKEN_S="0.002")
+    fleet.start()
+    gw = None
+    try:
+        # admission off: the outcome must be deterministic across the
+        # chaos gate's repeats, not dependent on queue timing
+        gw = make_gateway(fleet, admission=False,
+                          prefill_pool=("replica-2",),
+                          disagg_threshold_tokens=32)
+        gen = LoadGenerator(targets_fn=lambda: {"gw": gw.base_url},
+                            hang_timeout_s=60.0, max_attempts=4)
+        long_base = list(range(96))
+        trace = []
+        for i in range(10):
+            # alternate short chat turns with long RAG-style prompts that
+            # all share one context -> every long request wants the pool
+            if i % 2 == 0:
+                # a distinct leading token per request -> distinct chains,
+                # so EVERY long request takes the two-phase path
+                ids = [7000 + i] + long_base
+                trace.append({"id": i, "at": 0.05 * i, "prompt_ids": ids,
+                              "max_new_tokens": 12, "sampled": False,
+                              "session": 0})
+            else:
+                trace.append({"id": i, "at": 0.05 * i,
+                              "prompt_ids": [500 + i] * 12,
+                              "max_new_tokens": 8, "sampled": False,
+                              "session": -1})
+
+        killed = {}
+
+        def kill_prefill_pool():
+            wait_for(
+                lambda: any(d.get("prefill_replica")
+                            for d in gw.router.stats()["recent_decisions"]),
+                msg="first two-phase placement")
+            killed["name"] = "replica-2"
+            fleet.kill("replica-2")
+
+        killer = threading.Thread(target=kill_prefill_pool, daemon=True)
+        killer.start()
+        report = gen.run(trace)
+        killer.join(timeout=30)
+        counts = report.counts()
+        assert counts["corrupted"] == 0, report.to_dict()
+        assert counts["hung"] == 0, report.to_dict()
+        assert counts["failed"] == 0, report.to_dict()
+        assert counts["completed"] + counts["retried"] == len(trace)
+        assert killed, "kill thread never fired"
+        # phase-1 token accounting drains even for orphaned migrations
+        wait_for(lambda: gw.router.stats()["prefill_tokens"] == {},
+                 msg="prefill tokens drained after kill")
+        # the supervisor restarts the pool replica behind the gateway
+        wait_for(fleet.all_healthy, msg="fleet recovered after kill")
+    finally:
+        if gw is not None:
+            gw.stop()
+        fleet.stop()

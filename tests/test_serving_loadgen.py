@@ -213,3 +213,41 @@ def test_truncated_stream_is_death_not_corruption():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# -- recorded-trace replay (disagg satellite) --------------------------------
+def test_recorded_trace_file_replays_byte_stable(tmp_path):
+    """``kind="file:<path>.jsonl"`` replays recorded traffic: arrivals
+    re-based so the earliest is 0, prompt/tenant carried through, and
+    trace_json byte-stable (same file in, same trace out)."""
+    path = tmp_path / "prod.jsonl"
+    path.write_text(
+        '{"timestamp": 1000.5, "prompt": [1, 2, 3], "tenant": "acme"}\n'
+        "\n"  # blank lines are skipped
+        '{"timestamp": 1000.0, "prompt_ids": [4, 5], "max_new_tokens": 3,'
+        ' "sampled": true, "session": 7}\n'
+        '{"at": 1001.2, "prompt": [6]}\n'
+    )
+    spec = TraceSpec(kind=f"file:{path}")
+    trace = generate_trace(spec)
+    assert [e["at"] for e in trace] == [0.0, 0.5, 1.2]
+    assert trace[0] == {"id": 1, "at": 0.0, "prompt_ids": [4, 5],
+                        "max_new_tokens": 3, "sampled": True,
+                        "session": 7, "tenant": ""}
+    assert trace[1]["prompt_ids"] == [1, 2, 3]
+    assert trace[1]["tenant"] == "acme"
+    assert trace[1]["max_new_tokens"] == 16  # default when unrecorded
+    assert trace_json(spec) == trace_json(TraceSpec(kind=f"file:{path}"))
+
+
+def test_recorded_trace_rejects_bad_records(tmp_path):
+    import pytest as _pytest
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"timestamp": 0.0}\n')  # no prompt at all
+    with _pytest.raises(ValueError, match="bad.jsonl:1: bad trace record"):
+        generate_trace(TraceSpec(kind=f"file:{bad}"))
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("\n\n")
+    with _pytest.raises(ValueError, match="empty trace file"):
+        generate_trace(TraceSpec(kind=f"file:{empty}"))
